@@ -1,0 +1,55 @@
+"""ray_tpu — a TPU-native distributed compute framework.
+
+The capability surface of Ray (reference: /root/reference, wingkitlee0/ray)
+re-designed TPU-first: tasks/actors/objects over a single-host controller per
+TPU host, XLA/ICI collectives instead of NCCL, pjit/shard_map parallelism
+instead of DDP, and jax.jit compute in Train/Serve/RLlib.
+
+This module imports no jax — workers cold-start fast; accelerator code lives
+in ray_tpu.parallel / models / ops / train and is imported on use.
+"""
+
+from ._version import __version__
+from ._private.object_ref import ObjectRef, ObjectRefGenerator, DynamicObjectRefGenerator
+from .actor import ActorClass, ActorHandle, method, exit_actor
+from .api import (
+    available_resources,
+    cancel,
+    cluster_resources,
+    get,
+    get_actor,
+    init,
+    is_initialized,
+    kill,
+    nodes,
+    put,
+    remote,
+    shutdown,
+    timeline,
+    wait,
+)
+from .remote_function import RemoteFunction
+from .runtime_context import get_runtime_context, get_tpu_ids
+from . import exceptions
+
+__all__ = [
+    "__version__",
+    "ActorClass", "ActorHandle", "ObjectRef", "ObjectRefGenerator",
+    "DynamicObjectRefGenerator", "RemoteFunction",
+    "available_resources", "cancel", "cluster_resources", "exceptions",
+    "exit_actor", "get", "get_actor", "get_runtime_context", "get_tpu_ids",
+    "init", "is_initialized", "kill", "method", "nodes", "put", "remote",
+    "shutdown", "timeline", "wait",
+]
+
+_LAZY_SUBMODULES = ("parallel", "models", "ops", "train", "tune", "data",
+                    "serve", "rllib", "util")
+
+
+def __getattr__(name):
+    if name in _LAZY_SUBMODULES:
+        import importlib
+        mod = importlib.import_module(f".{name}", __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(f"module 'ray_tpu' has no attribute '{name}'")

@@ -1,0 +1,338 @@
+"""Runtime clients: driver-side (in-process controller) and worker-side (socket).
+
+Reference split: python/ray/_private/worker.py (driver/worker modes) over the
+cython core_worker. Both clients expose the same surface so `ray_tpu.api`
+works identically in driver code and inside tasks/actors.
+"""
+
+import concurrent.futures
+import socket
+import threading
+import asyncio
+
+from .. import exceptions as exc
+from . import ids, protocol, serialization
+from .object_store import StoreClient
+from .task_spec import TaskSpec
+
+_INLINE_MAX = 64 * 1024
+
+
+class BaseClient:
+    """Shared materialization: descriptor → value using the local store."""
+
+    def __init__(self):
+        self.store = StoreClient()
+        self.job_id = None
+
+    def _materialize(self, oids, descs):
+        out = []
+        for oid, (kind, payload) in zip(oids, descs):
+            if kind == "err":
+                raise payload
+            if kind == "inline":
+                out.append(serialization.unpack(payload))
+            else:  # shm
+                out.append(self.store.get(oid, payload))
+        return out
+
+    def _encode_to_store(self, oid, value):
+        """Serialize once; returns (meta_len, size, inline_or_None). Writes
+        shm only when over the inline threshold."""
+        meta, buffers = serialization.dumps_oob(value)
+        size = serialization.total_size(meta, buffers)
+        if size <= _INLINE_MAX:
+            return 0, size, serialization.pack_parts(meta, buffers)
+        self.store.put_parts(oid, meta, buffers)
+        return len(meta), size, None
+
+    def close(self):
+        self.store.close()
+
+
+class DriverClient(BaseClient):
+    """Runs in the driver process; controller lives on a background thread."""
+
+    def __init__(self, controller, loop):
+        super().__init__()
+        self.controller = controller
+        self.loop = loop
+        self.store = controller.store
+        self.job_id = controller.job_id
+        self.is_driver = True
+
+    def _call(self, coro, timeout=None):
+        fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
+        try:
+            return fut.result(timeout)
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            raise exc.GetTimeoutError("operation timed out") from None
+
+    def _call_soon(self, fn, *args):
+        """Run fn on the controller loop and wait (thread-safe sync bridge)."""
+        done = concurrent.futures.Future()
+
+        def run():
+            try:
+                done.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001
+                done.set_exception(e)
+
+        self.loop.call_soon_threadsafe(run)
+        return done.result()
+
+    # -- api surface --------------------------------------------------------
+    def submit(self, spec: TaskSpec):
+        return self._call(self.controller.submit(spec))
+
+    def get(self, oids, timeout=None):
+        descs = self._call(self.controller.get_descriptors(oids, timeout),
+                           timeout=None if timeout is None else timeout + 5)
+        return self._materialize(oids, descs)
+
+    def put(self, value):
+        oid = ids.object_id()
+        meta_len, size, inline = self._encode_to_store(oid, value)
+        self._call_soon(self.controller.register_put, oid, meta_len, size, inline)
+        return oid
+
+    def wait(self, oids, num_returns, timeout):
+        return self._call(self.controller.wait(oids, num_returns, timeout))
+
+    def cancel(self, task_id, force=False):
+        self._call_soon(self.controller.cancel, task_id, force)
+
+    def kill_actor(self, actor_id, no_restart=True):
+        self._call_soon(self.controller.kill_actor, actor_id, no_restart)
+
+    def get_actor(self, name, namespace=None):
+        return self._call_soon(self.controller.lookup_actor, name, namespace)
+
+    def register_actor(self, spec, options):
+        return self._call_soon(self.controller.register_actor, spec, options)
+
+    def decref(self, oid):
+        try:
+            self.loop.call_soon_threadsafe(self.controller.decref, [oid])
+        except RuntimeError:
+            pass  # loop already closed at shutdown
+
+    def resources(self):
+        return (self._call_soon(lambda: dict(self.controller.total)),
+                self._call_soon(lambda: dict(self.controller.available)))
+
+    def state(self, kind):
+        return self._call_soon(self.controller.state_snapshot, kind)
+
+    def next_stream_item(self, task_id, index, timeout=None):
+        return self._call(self.controller.next_stream_item(task_id, index, timeout))
+
+    def create_placement_group(self, bundles, strategy, name=""):
+        return self._call_soon(self.controller.create_placement_group, bundles, strategy, name)
+
+    def remove_placement_group(self, pg_id):
+        self._call_soon(self.controller.remove_placement_group, pg_id)
+
+    def as_future(self, ref):
+        out = concurrent.futures.Future()
+
+        def done(descs_fut):
+            try:
+                descs = descs_fut.result()
+                out.set_result(self._materialize([ref.id], descs)[0])
+            except BaseException as e:  # noqa: BLE001
+                out.set_exception(e)
+
+        fut = asyncio.run_coroutine_threadsafe(
+            self.controller.get_descriptors([ref.id], None), self.loop)
+        fut.add_done_callback(done)
+        return out
+
+    def timeline(self):
+        return self._call_soon(lambda: list(self.controller.timeline_events))
+
+
+class WorkerClient(BaseClient):
+    """Runs inside worker processes; all ops are socket RPCs to the controller.
+
+    A dedicated receiver thread demultiplexes: "exec" messages feed the task
+    loop, "resp" messages resolve pending request futures.
+    """
+
+    def __init__(self, socket_path: str, worker_id: str):
+        super().__init__()
+        self.worker_id = worker_id
+        self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self.sock.connect(socket_path)
+        self.is_driver = False
+        self._lock = threading.Lock()
+        self._reqs = {}
+        self._req_counter = 0
+        self.task_queue = []  # consumed by worker_main
+        self.task_available = threading.Condition()
+        self._current = threading.local()  # per-exec-thread task id
+        self.task_threads = {}  # task_id -> thread ident (for targeted cancel)
+        protocol.send_msg(self.sock, "register", worker_id=worker_id, pid=__import__("os").getpid())
+        self._recv_thread = threading.Thread(target=self._recv_loop, daemon=True)
+        self._recv_thread.start()
+
+    @property
+    def current_task_id(self):
+        return getattr(self._current, "task_id", None)
+
+    @current_task_id.setter
+    def current_task_id(self, value):
+        self._current.task_id = value
+        ident = threading.get_ident()
+        if value is None:
+            for tid, i in list(self.task_threads.items()):
+                if i == ident:
+                    del self.task_threads[tid]
+        else:
+            self.task_threads[value] = ident
+
+    def _cancel_exec(self, task_id):
+        """Raise KeyboardInterrupt in the thread executing task_id (ref: Ray
+        interrupts workers with SIGINT; we target the exact thread)."""
+        ident = self.task_threads.get(task_id)
+        if ident is None:
+            return
+        import ctypes
+        ctypes.pythonapi.PyThreadState_SetAsyncExc(
+            ctypes.c_ulong(ident), ctypes.py_object(KeyboardInterrupt))
+
+    def _recv_loop(self):
+        while True:
+            try:
+                msg = protocol.recv_msg(self.sock)
+            except OSError:
+                msg = None
+            if msg is None:
+                # controller gone: unblock everything, then die with the ship
+                with self.task_available:
+                    self.task_queue.append(None)
+                    self.task_available.notify_all()
+                for fut in list(self._reqs.values()):
+                    if not fut.done():
+                        fut.set_exception(ConnectionError("controller connection lost"))
+                return
+            kind, p = msg
+            if kind == "exec":
+                with self.task_available:
+                    self.task_queue.append(p)
+                    self.task_available.notify_all()
+            elif kind == "cancel_exec":
+                self._cancel_exec(p["task_id"])
+            elif kind == "resp":
+                fut = self._reqs.pop(p.pop("req_id"), None)
+                if fut is not None and not fut.done():
+                    if "error" in p:
+                        fut.set_exception(p["error"])
+                    else:
+                        fut.set_result(p)
+            elif kind == "exit":
+                import os
+                os._exit(0)
+
+    def _rpc(self, kind, timeout=None, **payload):
+        with self._lock:
+            self._req_counter += 1
+            req_id = self._req_counter
+            fut = concurrent.futures.Future()
+            self._reqs[req_id] = fut
+            protocol.send_msg(self.sock, kind, req_id=req_id, **payload)
+        return fut.result(timeout)
+
+    def _send(self, kind, **payload):
+        with self._lock:
+            protocol.send_msg(self.sock, kind, **payload)
+
+    # -- api surface --------------------------------------------------------
+    def submit(self, spec: TaskSpec):
+        return self._rpc("submit", spec=spec)["refs"]
+
+    def get(self, oids, timeout=None):
+        # release our cpu while blocked so the pool can progress (ref: raylet
+        # NotifyDirectCallTaskBlocked)
+        tid = self.current_task_id
+        if tid:
+            self._send("blocked", task_id=tid)
+        try:
+            p = self._rpc("get", oids=oids, timeout=timeout)
+        finally:
+            if tid:
+                self._send("unblocked", task_id=tid)
+        return self._materialize(oids, p["results"])
+
+    def put(self, value):
+        oid = ids.object_id()
+        meta_len, size, inline = self._encode_to_store(oid, value)
+        self._rpc("put", oid=oid, meta_len=meta_len, size=size, inline=inline)
+        return oid
+
+    def put_result(self, oid, value):
+        """Store a task result; returns (oid, meta_len, size, inline)."""
+        meta_len, size, inline = self._encode_to_store(oid, value)
+        return (oid, meta_len, size, inline)
+
+    def wait(self, oids, num_returns, timeout):
+        tid = self.current_task_id
+        if tid:
+            self._send("blocked", task_id=tid)
+        try:
+            p = self._rpc("wait", oids=oids, num_returns=num_returns, timeout=timeout)
+        finally:
+            if tid:
+                self._send("unblocked", task_id=tid)
+        return p["ready"], p["not_ready"]
+
+    def cancel(self, task_id, force=False):
+        self._rpc("cancel", task_id=task_id, force=force)
+
+    def kill_actor(self, actor_id, no_restart=True):
+        self._rpc("kill_actor", actor_id=actor_id, no_restart=no_restart)
+
+    def get_actor(self, name, namespace=None):
+        return self._rpc("get_actor", name=name, namespace=namespace)["actor_id"]
+
+    def register_actor(self, spec, options):
+        # worker-side actor creation goes through submit path with options piggybacked
+        return self._rpc("register_actor_rpc", spec=spec, options=options)["actor_id"]
+
+    def decref(self, oid):
+        try:
+            self._send("decref", oids=[oid])
+        except OSError:
+            pass
+
+    def resources(self):
+        p = self._rpc("resources")
+        return p["total"], p["available"]
+
+    def state(self, kind):
+        raise NotImplementedError("state API is driver-only in round 1")
+
+    def next_stream_item(self, task_id, index, timeout=None):
+        return self._rpc("next_stream", task_id=task_id, index=index, timeout=timeout)["item"]
+
+    def create_placement_group(self, bundles, strategy, name=""):
+        raise NotImplementedError("placement groups are driver-only in round 1")
+
+    def remove_placement_group(self, pg_id):
+        raise NotImplementedError
+
+    def as_future(self, ref):
+        fut = concurrent.futures.Future()
+
+        def run():
+            try:
+                fut.set_result(self.get([ref.id])[0])
+            except BaseException as e:  # noqa: BLE001
+                fut.set_exception(e)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def notify_actor_exit(self, actor_id):
+        self._send("actor_exit", actor_id=actor_id)

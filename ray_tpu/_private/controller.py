@@ -1,0 +1,949 @@
+"""Single-host controller: GCS + raylet + object directory in one asyncio loop.
+
+Reference decomposition: src/ray/gcs (cluster/actor/object metadata),
+src/ray/raylet (local scheduler + worker pool), src/ray/core_worker (task
+submission, ref counting). On a TPU host we collapse these into one
+controller per host: the heavy data plane is XLA/ICI, so the control plane's
+job is bookkeeping, not throughput — a single event loop removes three IPC
+hops the reference pays (worker→raylet→GCS) on every task.
+
+Workers are separate processes connected over a unix socket (protocol.py).
+The driver shares the controller's process and calls coroutines directly.
+"""
+
+import asyncio
+import collections
+import os
+import signal
+import subprocess
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .. import exceptions as exc
+from . import ids, protocol
+from .object_store import StoreClient
+from .task_spec import ObjectMeta, TaskSpec
+
+# Scheduling states
+PENDING_DEPS = "PENDING_DEPS"
+PENDING = "PENDING"
+RUNNING = "RUNNING"
+DONE = "DONE"
+FAILED = "FAILED"
+CANCELLED = "CANCELLED"
+
+# Actor states (mirrors GCS actor state machine, src/ray/gcs/gcs_actor_manager)
+A_PENDING = "PENDING_CREATION"
+A_ALIVE = "ALIVE"
+A_RESTARTING = "RESTARTING"
+A_DEAD = "DEAD"
+
+_INLINE_MAX = 64 * 1024
+DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_STORE_BYTES", 8 << 30))
+
+
+@dataclass
+class TaskRecord:
+    spec: TaskSpec
+    result_oids: List[str]
+    state: str = PENDING
+    retries_left: int = 0
+    worker_id: Optional[str] = None
+    done: asyncio.Event = field(default_factory=asyncio.Event)
+    deps_remaining: Set[str] = field(default_factory=set)
+    pinned: List[str] = field(default_factory=list)
+    ts_submit: float = 0.0
+    ts_start: float = 0.0
+    ts_end: float = 0.0
+    cancelled: bool = False
+
+
+@dataclass
+class StreamState:
+    items: list = field(default_factory=list)  # object ids in yield order
+    finished: bool = False
+    error: Optional[Exception] = None
+    cond: asyncio.Event = field(default_factory=asyncio.Event)
+
+
+@dataclass
+class WorkerConn:
+    worker_id: str
+    writer: asyncio.StreamWriter = None
+    proc: subprocess.Popen = None
+    state: str = "starting"  # starting | idle | busy | dead
+    running: Set[str] = field(default_factory=set)
+    actor_id: Optional[str] = None  # dedicated actor worker
+    blocked_tasks: Set[str] = field(default_factory=set)
+    pid: int = 0
+
+
+@dataclass
+class ActorRecord:
+    actor_id: str
+    creation_spec: TaskSpec = None
+    options: object = None
+    state: str = A_PENDING
+    worker_id: Optional[str] = None
+    queue: collections.deque = field(default_factory=collections.deque)  # queued TaskRecords
+    in_flight: Set[str] = field(default_factory=set)
+    restarts_used: int = 0
+    name: Optional[str] = None
+    namespace: str = "default"
+    death_reason: str = ""
+    env: dict = field(default_factory=dict)
+
+
+@dataclass
+class Bundle:
+    resources: Dict[str, float]
+    available: Dict[str, float]
+
+
+@dataclass
+class PlacementGroupRecord:
+    pg_id: str
+    bundles: List[Bundle]
+    strategy: str = "PACK"
+    state: str = "CREATED"
+    name: str = ""
+
+
+class Controller:
+    def __init__(self, socket_path: str, resources: Dict[str, float], job_id: str,
+                 max_workers: int = None, store_capacity: int = DEFAULT_CAPACITY):
+        self.socket_path = socket_path
+        self.job_id = job_id
+        self.node_id = ids.node_id()
+        self.loop: asyncio.AbstractEventLoop = None
+        self.store = StoreClient()
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.max_workers = max_workers or (int(resources.get("CPU", 1)) + 2)
+
+        self.objects: Dict[str, ObjectMeta] = {}
+        self.object_events: Dict[str, asyncio.Event] = {}
+        self.tasks: Dict[str, TaskRecord] = {}
+        self.ready_queue: collections.deque = collections.deque()
+        self.dep_waiters: Dict[str, Set[str]] = collections.defaultdict(set)
+        self.workers: Dict[str, WorkerConn] = {}
+        self.spawning: Dict[str, WorkerConn] = {}
+        self.actors: Dict[str, ActorRecord] = {}
+        self.named_actors: Dict[tuple, str] = {}
+        self.pgroups: Dict[str, PlacementGroupRecord] = {}
+        self.streams: Dict[str, StreamState] = {}
+        self.pending_reqs: Dict[str, asyncio.Future] = {}
+        self.store_used = 0
+        self.store_capacity = store_capacity
+        self.tpu_free: List[int] = list(range(int(resources.get("TPU", 0))))
+        self._server = None
+        self._shutdown = False
+        self.timeline_events: List[dict] = []
+
+    # ------------------------------------------------------------------ setup
+    async def start(self):
+        self.loop = asyncio.get_running_loop()
+        self._server = await asyncio.start_unix_server(self._on_conn, path=self.socket_path)
+        self.loop.create_task(self._reaper())
+
+    async def shutdown(self):
+        self._shutdown = True
+        for w in list(self.workers.values()) + list(self.spawning.values()):
+            self._kill_worker_proc(w)
+        if self._server:
+            self._server.close()
+        for oid, meta in list(self.objects.items()):
+            if meta.location == "shm":
+                self.store.delete_segment(oid)
+            elif meta.location == "spilled" and meta.spill_path:
+                try:
+                    os.remove(meta.spill_path)
+                except OSError:
+                    pass
+        self.objects.clear()
+        self.store.close()
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def _kill_worker_proc(self, w: WorkerConn):
+        if w.proc is not None and w.proc.poll() is None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+
+    async def _reaper(self):
+        """Detect spawned workers that died before registering (ref: raylet
+        worker-pool startup token timeout)."""
+        while not self._shutdown:
+            await asyncio.sleep(1.0)
+            for wid, w in list(self.spawning.items()):
+                if w.proc.poll() is not None:
+                    del self.spawning[wid]
+                    self._on_worker_dead(w, f"worker process exited code={w.proc.returncode} before registering")
+            self._schedule()
+
+    # ------------------------------------------------------- worker connection
+    async def _on_conn(self, reader, writer):
+        msg = await protocol.aread_msg(reader)
+        if msg is None or msg[0] != "register":
+            writer.close()
+            return
+        wid = msg[1]["worker_id"]
+        w = self.spawning.pop(wid, None) or WorkerConn(worker_id=wid)
+        w.writer = writer
+        w.pid = msg[1].get("pid", 0)
+        w.state = "idle"
+        self.workers[wid] = w
+        if w.actor_id:
+            # dedicated actor worker: dispatch the pending creation task
+            actor = self.actors.get(w.actor_id)
+            if actor and actor.creation_spec is not None:
+                rec = self.tasks[actor.creation_spec.task_id]
+                self._dispatch(rec, w)
+        self._schedule()
+        try:
+            while True:
+                msg = await protocol.aread_msg(reader)
+                if msg is None:
+                    break
+                await self._handle_worker_msg(w, msg[0], msg[1])
+        finally:
+            if not self._shutdown:
+                self.workers.pop(wid, None)
+                self._on_worker_dead(w, "worker connection closed")
+                self._schedule()
+
+    async def _handle_worker_msg(self, w: WorkerConn, kind: str, p: dict):
+        if kind == "task_done":
+            self._on_task_done(w, p)
+        elif kind == "stream_item":
+            self._on_stream_item(p)
+        elif kind == "submit":
+            oids = await self.submit(p["spec"])
+            self._reply(w, p["req_id"], refs=oids)
+        elif kind == "get":
+            self.loop.create_task(self._worker_get(w, p))
+        elif kind == "wait":
+            self.loop.create_task(self._worker_wait(w, p))
+        elif kind == "put":
+            self.register_put(p["oid"], p["meta_len"], p["size"], p.get("inline"))
+            self._reply(w, p["req_id"], ok=True)
+        elif kind == "blocked":
+            self._on_blocked(w, p["task_id"])
+        elif kind == "unblocked":
+            self._on_unblocked(w, p["task_id"])
+        elif kind == "decref":
+            self.decref(p["oids"])
+        elif kind == "next_stream":
+            self.loop.create_task(self._worker_next_stream(w, p))
+        elif kind == "register_actor_rpc":
+            try:
+                aid = self.register_actor(p["spec"], p["options"])
+                self._reply(w, p["req_id"], actor_id=aid)
+            except ValueError as e:
+                self._reply(w, p["req_id"], error=e)
+        elif kind == "get_actor":
+            try:
+                aid = self.lookup_actor(p["name"], p.get("namespace"))
+                self._reply(w, p["req_id"], actor_id=aid)
+            except ValueError as e:
+                self._reply(w, p["req_id"], error=e)
+        elif kind == "kill_actor":
+            self.kill_actor(p["actor_id"], no_restart=p.get("no_restart", True))
+            self._reply(w, p["req_id"], ok=True)
+        elif kind == "cancel":
+            self.cancel(p["task_id"], force=p.get("force", False))
+            self._reply(w, p["req_id"], ok=True)
+        elif kind == "resources":
+            self._reply(w, p["req_id"], total=dict(self.total), available=dict(self.available))
+        elif kind == "actor_exit":
+            # graceful exit_actor(): mark dead without restart
+            actor = self.actors.get(p["actor_id"])
+            if actor:
+                self._fail_actor(actor, "exit_actor() called", allow_restart=False)
+
+    def _reply(self, w: WorkerConn, req_id, **payload):
+        protocol.awrite_msg(w.writer, "resp", req_id=req_id, **payload)
+
+    async def _worker_get(self, w, p):
+        try:
+            results = await self.get_descriptors(p["oids"], p.get("timeout"))
+            self._reply(w, p["req_id"], results=results)
+        except Exception as e:  # noqa: BLE001 - ship the error to the caller
+            self._reply(w, p["req_id"], error=e)
+
+    async def _worker_wait(self, w, p):
+        ready, not_ready = await self.wait(p["oids"], p["num_returns"], p.get("timeout"))
+        self._reply(w, p["req_id"], ready=ready, not_ready=not_ready)
+
+    async def _worker_next_stream(self, w, p):
+        try:
+            item = await self.next_stream_item(p["task_id"], p["index"], p.get("timeout"))
+            self._reply(w, p["req_id"], item=item)
+        except Exception as e:  # noqa: BLE001
+            self._reply(w, p["req_id"], error=e)
+
+    # ------------------------------------------------------------- submission
+    async def submit(self, spec: TaskSpec) -> List[str]:
+        """Register a task; returns result object ids immediately (futures)."""
+        if spec.num_returns == "streaming":
+            result_oids = [ids.object_id()]  # the generator handle id
+            self.streams[spec.task_id] = StreamState()
+        else:
+            result_oids = [ids.object_id() for _ in range(max(spec.num_returns, 1))]
+        for oid in result_oids:
+            self.objects[oid] = ObjectMeta(object_id=oid, creating_task=spec.task_id)
+            self.object_events[oid] = asyncio.Event()
+        rec = TaskRecord(spec=spec, result_oids=result_oids,
+                         retries_left=spec.max_retries, ts_submit=time.time())
+        self.tasks[spec.task_id] = rec
+        # dependency tracking: top-level ref args must be local before dispatch.
+        # Pin every ref arg for the task's lifetime so caller-side GC of the
+        # ObjectRef can't evict an argument in flight (ref: task specs hold
+        # references in the reference counter, reference_count.cc).
+        for kind, v in list(spec.args) + list(spec.kwargs.values()):
+            if kind == "ref":
+                meta = self.objects.get(v)
+                if meta is not None:
+                    meta.pinned += 1
+                    rec.pinned.append(v)
+                if meta is None or meta.location == "pending":
+                    rec.deps_remaining.add(v)
+                    self.dep_waiters[v].add(spec.task_id)
+        self._validate_feasible(rec)
+        if rec.state == FAILED:
+            return result_oids
+        if rec.deps_remaining:
+            rec.state = PENDING_DEPS
+        else:
+            self._enqueue_ready(rec)
+        self._schedule()
+        return result_oids
+
+    def _validate_feasible(self, rec: TaskRecord):
+        need = rec.spec.resources
+        if rec.spec.placement_group_id:
+            pg = self.pgroups.get(rec.spec.placement_group_id)
+            if pg is None:
+                self._fail_task(rec, ValueError("placement group not found"))
+            return
+        for k, v in need.items():
+            if v > self.total.get(k, 0):
+                self._fail_task(rec, ValueError(
+                    f"Resource request {k}={v} exceeds cluster total {self.total.get(k, 0)} "
+                    f"(infeasible; reference behavior: hang + warning — we fail fast)"))
+                return
+
+    def _enqueue_ready(self, rec: TaskRecord):
+        rec.state = PENDING
+        if rec.spec.actor_id and not rec.spec.is_actor_creation:
+            actor = self.actors.get(rec.spec.actor_id)
+            if actor is None:
+                self._fail_task(rec, exc.ActorDiedError(rec.spec.actor_id, "unknown actor"))
+                return
+            if actor.state == A_DEAD:
+                self._fail_task(rec, exc.ActorDiedError(actor.actor_id, actor.death_reason))
+                return
+            actor.queue.append(rec)
+        else:
+            self.ready_queue.append(rec)
+
+    # -------------------------------------------------------------- scheduling
+    def _resources_fit(self, need: Dict[str, float], pool: Dict[str, float]) -> bool:
+        return all(pool.get(k, 0) + 1e-9 >= v for k, v in need.items())
+
+    def _claim(self, need: Dict[str, float], pool: Dict[str, float]):
+        for k, v in need.items():
+            pool[k] = pool.get(k, 0) - v
+
+    def _release(self, need: Dict[str, float], pool: Dict[str, float]):
+        for k, v in need.items():
+            pool[k] = pool.get(k, 0) + v
+
+    def _task_pool(self, spec: TaskSpec) -> Dict[str, float]:
+        if spec.placement_group_id:
+            pg = self.pgroups[spec.placement_group_id]
+            idx = spec.placement_group_bundle_index
+            bundle = pg.bundles[idx if idx >= 0 else 0]
+            return bundle.available
+        return self.available
+
+    def _schedule(self):
+        """Greedy dispatch loop; called after every state change (ref:
+        raylet's ScheduleAndDispatchTasks)."""
+        if self._shutdown:
+            return
+        # 1. plain tasks → idle pool workers
+        progressing = True
+        while progressing:
+            progressing = False
+            for _ in range(len(self.ready_queue)):
+                rec = self.ready_queue.popleft()
+                if rec.state != PENDING:
+                    continue
+                pool = self._task_pool(rec.spec)
+                if not self._resources_fit(rec.spec.resources, pool):
+                    self.ready_queue.append(rec)
+                    continue
+                if rec.spec.is_actor_creation:
+                    self._start_actor_worker(rec, pool)
+                    progressing = True
+                    continue
+                w = self._find_idle_worker()
+                if w is None:
+                    self.ready_queue.append(rec)
+                    continue
+                self._claim(rec.spec.resources, pool)
+                self._assign_tpus(rec)
+                self._dispatch(rec, w)
+                progressing = True
+        # spawn workers to match queued demand (never more than cpu slots)
+        demand = sum(1 for rec in self.ready_queue
+                     if rec.state == PENDING and not rec.spec.is_actor_creation
+                     and self._resources_fit(rec.spec.resources, self._task_pool(rec.spec)))
+        self._spawn_for_demand(demand)
+        # 2. actor method calls → their dedicated workers
+        for actor in self.actors.values():
+            if actor.state != A_ALIVE:
+                continue
+            w = self.workers.get(actor.worker_id)
+            if w is None:
+                continue
+            limit = max(actor.options.max_concurrency, 1) if actor.options else 1
+            while actor.queue and len(actor.in_flight) < limit:
+                rec = actor.queue.popleft()
+                if rec.state != PENDING:
+                    continue
+                actor.in_flight.add(rec.spec.task_id)
+                self._dispatch(rec, w)
+
+    def _find_idle_worker(self) -> Optional[WorkerConn]:
+        for w in self.workers.values():
+            if w.state == "idle" and w.actor_id is None:
+                return w
+        return None
+
+    def _spawn_for_demand(self, demand: int):
+        if demand <= 0:
+            return
+        spawning = sum(1 for w in self.spawning.values() if w.actor_id is None)
+        n_alive = sum(1 for w in list(self.workers.values()) + list(self.spawning.values())
+                      if w.actor_id is None and w.state != "dead")
+        n_blocked = sum(1 for w in self.workers.values()
+                        if w.actor_id is None and w.blocked_tasks)
+        headroom = self.max_workers - (n_alive - n_blocked)
+        for _ in range(max(0, min(demand - spawning, headroom))):
+            self._spawn_worker()
+
+    def _spawn_worker(self, actor: ActorRecord = None) -> WorkerConn:
+        wid = ids.worker_id()
+        env = dict(os.environ)
+        env["RAY_TPU_WORKER_ID"] = wid
+        if actor is not None:
+            env.update({k: str(v) for k, v in (actor.env or {}).items()})
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.worker_main", self.socket_path, wid],
+            env=env, stdin=subprocess.DEVNULL)
+        w = WorkerConn(worker_id=wid, proc=proc, actor_id=actor.actor_id if actor else None)
+        self.spawning[wid] = w
+        return w
+
+    def _start_actor_worker(self, rec: TaskRecord, pool: Dict[str, float]):
+        """Actor creation always gets a dedicated worker (ref: raylet leases a
+        worker for the actor's lifetime). TPU actors get chip binding env."""
+        self._claim(rec.spec.resources, pool)
+        rec.state = "SPAWNING"
+        actor = self.actors[rec.spec.actor_id]
+        self._assign_tpus(rec, actor)
+        self._spawn_worker(actor)
+
+    def _assign_tpus(self, rec: TaskRecord, actor: ActorRecord = None):
+        n = int(rec.spec.resources.get("TPU", 0))
+        if n <= 0:
+            return
+        assigned, self.tpu_free = self.tpu_free[:n], self.tpu_free[n:]
+        rec.spec.runtime_env = dict(rec.spec.runtime_env or {})
+        rec.spec.runtime_env["_tpu_ids"] = assigned
+        if actor is not None:
+            # chip visibility must be set before jax imports in the new process
+            actor.env["TPU_VISIBLE_CHIPS"] = ",".join(map(str, assigned))
+            actor.env["RAY_TPU_IDS"] = ",".join(map(str, assigned))
+
+    def _dispatch(self, rec: TaskRecord, w: WorkerConn):
+        rec.state = RUNNING
+        rec.worker_id = w.worker_id
+        rec.ts_start = time.time()
+        w.running.add(rec.spec.task_id)
+        if w.actor_id is None:
+            w.state = "busy"
+        protocol.awrite_msg(w.writer, "exec", spec=rec.spec, result_oids=rec.result_oids)
+
+    # -------------------------------------------------------------- completion
+    def _on_task_done(self, w: WorkerConn, p: dict):
+        task_id = p["task_id"]
+        rec = self.tasks.get(task_id)
+        w.running.discard(task_id)
+        w.blocked_tasks.discard(task_id)
+        if w.actor_id is None and not w.running:
+            w.state = "idle"
+        if rec is None:
+            self._schedule()
+            return
+        rec.ts_end = time.time()
+        self.timeline_events.append({
+            "name": rec.spec.name or task_id, "ph": "X", "pid": 1, "tid": w.pid or 1,
+            "ts": rec.ts_start * 1e6, "dur": max(rec.ts_end - rec.ts_start, 1e-6) * 1e6})
+        spec = rec.spec
+        actor = self.actors.get(spec.actor_id) if spec.actor_id else None
+        if actor is not None and not spec.is_actor_creation:
+            actor.in_flight.discard(task_id)
+        err = p.get("error")
+        if err is not None and rec.cancelled:
+            err = exc.TaskCancelledError(task_id)
+        if err is not None:
+            retryable = (not spec.actor_id and rec.retries_left > 0 and
+                         (spec.retry_exceptions or isinstance(err, exc.WorkerCrashedError))
+                         and not rec.cancelled)
+            if retryable:
+                rec.retries_left -= 1
+                self._release_task_resources(rec)
+                self._enqueue_ready(rec)
+                self._schedule()
+                return
+            self._fail_task(rec, err)
+            if spec.is_actor_creation and actor is not None:
+                self._fail_actor(actor, f"creation failed: {err}", allow_restart=False)
+            self._release_task_resources(rec)
+            self._schedule()
+            return
+        # success: record result objects
+        for oid, meta_len, size, inline in p["results"]:
+            self.register_put(oid, meta_len, size, inline)
+        if spec.num_returns == "streaming":
+            st = self.streams.get(task_id)
+            if st:
+                st.finished = True
+                st.cond.set()
+        rec.state = DONE
+        rec.done.set()
+        if spec.is_actor_creation and actor is not None:
+            actor.state = A_ALIVE
+            actor.worker_id = w.worker_id
+        self._release_task_resources(rec)
+        self._unpin(rec)
+        self._schedule()
+
+    def _release_task_resources(self, rec: TaskRecord):
+        if rec.spec.actor_id and not rec.spec.is_actor_creation:
+            return  # methods run within the actor's standing allocation
+        pool = self._task_pool(rec.spec)
+        if rec.spec.is_actor_creation:
+            actor = self.actors.get(rec.spec.actor_id)
+            if actor is not None and actor.state == A_DEAD:
+                self._release(rec.spec.resources, pool)
+                tpus = (rec.spec.runtime_env or {}).get("_tpu_ids", [])
+                self.tpu_free.extend(tpus)
+            return  # alive actors keep their allocation
+        self._release(rec.spec.resources, pool)
+        tpus = (rec.spec.runtime_env or {}).get("_tpu_ids", [])
+        self.tpu_free.extend(tpus)
+
+    def _unpin(self, rec: TaskRecord):
+        for oid in rec.pinned:
+            meta = self.objects.get(oid)
+            if meta:
+                meta.pinned = max(meta.pinned - 1, 0)
+                if meta.refcount <= 0 and meta.pinned == 0:
+                    self._evict(oid)
+        rec.pinned.clear()
+
+    def _fail_task(self, rec: TaskRecord, err: Exception):
+        rec.state = CANCELLED if isinstance(err, exc.TaskCancelledError) else FAILED
+        self._unpin(rec)
+        for oid in rec.result_oids:
+            meta = self.objects.get(oid)
+            if meta is not None:
+                meta.error = err
+                meta.location = "error"
+                ev = self.object_events.get(oid)
+                if ev:
+                    ev.set()
+        st = self.streams.get(rec.spec.task_id)
+        if st is not None:
+            st.error = err
+            st.finished = True
+            st.cond.set()
+        rec.done.set()
+        # wake tasks depending on these now-errored objects
+        for oid in rec.result_oids:
+            self._resolve_dep(oid)
+
+    # ------------------------------------------------------------ object table
+    def register_put(self, oid: str, meta_len: int, size: int, inline: Optional[bytes]):
+        meta = self.objects.get(oid)
+        if meta is None:
+            meta = ObjectMeta(object_id=oid)
+            self.objects[oid] = meta
+            self.object_events[oid] = asyncio.Event()
+        meta.meta_len = meta_len
+        meta.size = size
+        if inline is not None:
+            meta.location = "inline"
+            meta.inline_value = inline
+        else:
+            meta.location = "shm"
+            self.store_used += size
+            self._maybe_spill()
+        self.object_events[oid].set()
+        self._resolve_dep(oid)
+
+    def _resolve_dep(self, oid: str):
+        for tid in self.dep_waiters.pop(oid, ()):
+            rec = self.tasks.get(tid)
+            if rec is None or rec.state != PENDING_DEPS:
+                continue
+            rec.deps_remaining.discard(oid)
+            if not rec.deps_remaining:
+                self._enqueue_ready(rec)
+        self._schedule()
+
+    def _maybe_spill(self):
+        """Spill oldest unpinned shm objects when over capacity (ref: plasma
+        eviction + object spilling, src/ray/object_manager/spilled_object)."""
+        if self.store_used <= self.store_capacity:
+            return
+        for oid, meta in list(self.objects.items()):
+            if self.store_used <= self.store_capacity * 0.8:
+                break
+            if meta.location == "shm" and meta.pinned == 0:
+                try:
+                    meta.spill_path = self.store.spill(oid)
+                    meta.location = "spilled"
+                    self.store_used -= meta.size
+                except Exception:  # noqa: BLE001 - best-effort under pressure
+                    continue
+
+    def _ensure_local(self, oid: str):
+        meta = self.objects[oid]
+        if meta.location == "spilled":
+            self.store.restore(oid, meta.spill_path)
+            meta.location = "shm"
+            meta.spill_path = None
+            self.store_used += meta.size
+
+    async def get_descriptors(self, oids: List[str], timeout: Optional[float]):
+        """Wait for availability; return per-object descriptors the caller can
+        materialize locally: ("shm", meta_len) | ("inline", bytes) | ("err", e)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        for oid in oids:
+            ev = self.object_events.get(oid)
+            if ev is None:
+                raise exc.ObjectLostError(oid)
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0 and not ev.is_set():
+                raise exc.GetTimeoutError(f"get() timed out waiting for {oid}")
+            try:
+                await asyncio.wait_for(ev.wait(), remaining)
+            except asyncio.TimeoutError:
+                raise exc.GetTimeoutError(f"get() timed out waiting for {oid}") from None
+        out = []
+        for oid in oids:
+            meta = self.objects[oid]
+            if meta.location == "error":
+                out.append(("err", meta.error))
+            elif meta.location == "inline":
+                out.append(("inline", meta.inline_value))
+            else:
+                self._ensure_local(oid)
+                out.append(("shm", meta.meta_len))
+        return out
+
+    async def wait(self, oids, num_returns, timeout):
+        deadline = None if timeout is None else time.monotonic() + timeout
+        pending = {oid: self.object_events[oid] for oid in oids}
+        ready = []
+        while len(ready) < num_returns:
+            done_now = [oid for oid in oids if oid not in ready and pending[oid].is_set()]
+            for oid in done_now:
+                if oid not in ready:
+                    ready.append(oid)
+                    if len(ready) >= num_returns:
+                        break
+            if len(ready) >= num_returns:
+                break
+            remaining = None if deadline is None else deadline - time.monotonic()
+            if remaining is not None and remaining <= 0:
+                break
+            waiters = [pending[oid].wait() for oid in oids if not pending[oid].is_set()]
+            if not waiters:
+                break
+            try:
+                await asyncio.wait_for(
+                    asyncio.wait([asyncio.ensure_future(x) for x in waiters],
+                                 return_when=asyncio.FIRST_COMPLETED),
+                    remaining)
+            except asyncio.TimeoutError:
+                break
+        ready_in_order = [oid for oid in oids if oid in set(ready)][:num_returns]
+        not_ready = [oid for oid in oids if oid not in set(ready_in_order)]
+        return ready_in_order, not_ready
+
+    def decref(self, oids: List[str]):
+        for oid in oids:
+            meta = self.objects.get(oid)
+            if meta is None:
+                continue
+            meta.refcount -= 1
+            if meta.refcount <= 0 and meta.pinned == 0:
+                self._evict(oid)
+
+    def incref(self, oids: List[str]):
+        for oid in oids:
+            meta = self.objects.get(oid)
+            if meta is not None:
+                meta.refcount += 1
+
+    def _evict(self, oid: str):
+        meta = self.objects.pop(oid, None)
+        if meta is None:
+            return
+        if meta.location == "shm":
+            self.store.delete_segment(oid)
+            self.store_used -= meta.size
+        elif meta.location == "spilled" and meta.spill_path:
+            try:
+                os.remove(meta.spill_path)
+            except OSError:
+                pass
+        self.object_events.pop(oid, None)
+
+    # ---------------------------------------------------------------- streaming
+    def _on_stream_item(self, p: dict):
+        self.register_put(p["oid"], p["meta_len"], p["size"], p.get("inline"))
+        st = self.streams.get(p["task_id"])
+        if st is not None:
+            st.items.append(p["oid"])
+            st.cond.set()
+
+    async def next_stream_item(self, task_id: str, index: int, timeout=None):
+        st = self.streams.get(task_id)
+        if st is None:
+            raise ValueError(f"no stream for task {task_id}")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if index < len(st.items):
+                return st.items[index]
+            if st.error is not None:
+                raise st.error if isinstance(st.error, Exception) else exc.TaskError("stream", str(st.error))
+            if st.finished:
+                return None  # StopIteration sentinel
+            st.cond.clear()
+            remaining = None if deadline is None else deadline - time.monotonic()
+            try:
+                await asyncio.wait_for(st.cond.wait(), remaining)
+            except asyncio.TimeoutError:
+                raise exc.GetTimeoutError("stream next() timed out") from None
+
+    # ------------------------------------------------------------------ actors
+    def register_actor(self, spec: TaskSpec, options) -> str:
+        actor = ActorRecord(actor_id=spec.actor_id, creation_spec=spec, options=options,
+                            name=options.name, namespace=options.namespace or "default")
+        if options.name:
+            key = (actor.namespace, options.name)
+            if key in self.named_actors:
+                raise ValueError(f"Actor name '{options.name}' already taken in namespace "
+                                 f"'{actor.namespace}'")
+            self.named_actors[key] = actor.actor_id
+        self.actors[actor.actor_id] = actor
+        return actor.actor_id
+
+    def lookup_actor(self, name: str, namespace: Optional[str]) -> str:
+        key = (namespace or "default", name)
+        aid = self.named_actors.get(key)
+        if aid is None or self.actors[aid].state == A_DEAD:
+            raise ValueError(f"Failed to look up actor '{name}' in namespace '{key[0]}'")
+        return aid
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True):
+        actor = self.actors.get(actor_id)
+        if actor is None:
+            return
+        w = self.workers.get(actor.worker_id)
+        if w is not None:
+            self._kill_worker_proc(w)
+        if no_restart:
+            actor.restarts_used = actor.options.max_restarts + 1 if actor.options else 1
+        self._fail_actor(actor, "killed via kill()", allow_restart=not no_restart)
+
+    def _fail_actor(self, actor: ActorRecord, reason: str, allow_restart: bool):
+        can_restart = (allow_restart and actor.options is not None and
+                       (actor.options.max_restarts == -1 or
+                        actor.restarts_used < actor.options.max_restarts))
+        if can_restart:
+            actor.restarts_used += 1
+            actor.state = A_RESTARTING
+            actor.worker_id = None
+            # re-run the creation spec on a fresh dedicated worker
+            cspec = actor.creation_spec
+            rec = TaskRecord(spec=cspec, result_oids=self.tasks[cspec.task_id].result_oids,
+                             ts_submit=time.time())
+            self.tasks[cspec.task_id] = rec
+            self._spawn_worker(actor)
+            rec.state = "SPAWNING"
+            return
+        actor.state = A_DEAD
+        actor.death_reason = reason
+        if actor.name:
+            self.named_actors.pop((actor.namespace, actor.name), None)
+        err = exc.ActorDiedError(actor.actor_id, reason)
+        for rec in list(actor.queue):
+            self._fail_task(rec, err)
+        actor.queue.clear()
+        for tid in list(actor.in_flight):
+            rec = self.tasks.get(tid)
+            if rec:
+                self._fail_task(rec, err)
+        actor.in_flight.clear()
+        # release the actor's standing resource allocation
+        if actor.creation_spec is not None:
+            pool = self._task_pool(actor.creation_spec)
+            self._release(actor.creation_spec.resources, pool)
+            tpus = (actor.creation_spec.runtime_env or {}).get("_tpu_ids", [])
+            self.tpu_free.extend(tpus)
+
+    def _on_worker_dead(self, w: WorkerConn, reason: str):
+        if w.state == "dead":
+            return
+        w.state = "dead"
+        crash = exc.WorkerCrashedError(reason)
+        for tid in list(w.running):
+            rec = self.tasks.get(tid)
+            if rec is None:
+                continue
+            spec = rec.spec
+            if spec.actor_id and not spec.is_actor_creation:
+                actor = self.actors.get(spec.actor_id)
+                if actor:
+                    actor.in_flight.discard(tid)
+                can_retry = (actor is not None and actor.options and
+                             rec.retries_left > 0 and actor.options.max_task_retries != 0)
+                if can_retry:
+                    rec.retries_left -= 1
+                    actor.queue.appendleft(rec)
+                    rec.state = PENDING
+                else:
+                    self._fail_task(rec, exc.ActorDiedError(spec.actor_id, reason)
+                                    if spec.actor_id else crash)
+            elif rec.retries_left > 0 and not rec.cancelled:
+                rec.retries_left -= 1
+                self._release_task_resources(rec)
+                self._enqueue_ready(rec)
+            else:
+                self._fail_task(rec, crash)
+                self._release_task_resources(rec)
+        w.running.clear()
+        if w.actor_id:
+            actor = self.actors.get(w.actor_id)
+            if actor is not None and actor.state in (A_ALIVE, A_PENDING):
+                self._fail_actor(actor, f"worker died: {reason}", allow_restart=True)
+
+    # ----------------------------------------------------------- cancel / kill
+    def cancel(self, task_id: str, force: bool = False):
+        if task_id.startswith("obj-"):
+            meta = self.objects.get(task_id)
+            task_id = meta.creating_task if meta else task_id
+        rec = self.tasks.get(task_id)
+        if rec is None:
+            return
+        rec.cancelled = True
+        if rec.state in (PENDING, PENDING_DEPS):
+            self._fail_task(rec, exc.TaskCancelledError(task_id))
+            try:
+                self.ready_queue.remove(rec)
+            except ValueError:
+                pass
+        elif rec.state == RUNNING:
+            w = self.workers.get(rec.worker_id)
+            if w is None:
+                return
+            if force:
+                self._kill_worker_proc(w)  # reaper/EOF path marks the task failed
+            else:
+                protocol.awrite_msg(w.writer, "cancel_exec", task_id=task_id)
+
+    # ------------------------------------------------------------- blocked mgmt
+    def _on_blocked(self, w: WorkerConn, task_id: str):
+        """Worker blocked in get(): release its cpu so the pool can make
+        progress (ref: raylet's NotifyWorkerBlocked / resource borrowing)."""
+        rec = self.tasks.get(task_id)
+        if rec is None or task_id in w.blocked_tasks:
+            return
+        w.blocked_tasks.add(task_id)
+        if not (rec.spec.actor_id and not rec.spec.is_actor_creation):
+            self._release(rec.spec.resources, self._task_pool(rec.spec))
+        self._schedule()
+
+    def _on_unblocked(self, w: WorkerConn, task_id: str):
+        rec = self.tasks.get(task_id)
+        if rec is None or task_id not in w.blocked_tasks:
+            return
+        w.blocked_tasks.discard(task_id)
+        if not (rec.spec.actor_id and not rec.spec.is_actor_creation):
+            # may drive available negative: intentional oversubscription, the
+            # scheduler simply won't dispatch until it recovers
+            self._claim(rec.spec.resources, self._task_pool(rec.spec))
+
+    # --------------------------------------------------------- placement groups
+    def create_placement_group(self, bundles: List[Dict[str, float]], strategy: str,
+                               name: str = "") -> str:
+        pg_id = ids.group_id()
+        for b in bundles:
+            if not self._resources_fit(b, self.available):
+                raise ValueError(f"Cannot reserve bundle {b}: insufficient resources "
+                                 f"(available={self.available})")
+        bs = []
+        for b in bundles:
+            self._claim(b, self.available)
+            bs.append(Bundle(resources=dict(b), available=dict(b)))
+        self.pgroups[pg_id] = PlacementGroupRecord(pg_id=pg_id, bundles=bs,
+                                                   strategy=strategy, name=name)
+        return pg_id
+
+    def remove_placement_group(self, pg_id: str):
+        pg = self.pgroups.pop(pg_id, None)
+        if pg is None:
+            return
+        for b in pg.bundles:
+            self._release(b.resources, self.available)
+
+    # ------------------------------------------------------------------- state
+    def state_snapshot(self, kind: str):
+        if kind == "actors":
+            return [{"actor_id": a.actor_id, "state": a.state, "name": a.name,
+                     "namespace": a.namespace, "pid": (self.workers.get(a.worker_id).pid
+                                                       if a.worker_id in self.workers else None),
+                     "restarts": a.restarts_used}
+                    for a in self.actors.values()]
+        if kind == "tasks":
+            return [{"task_id": t.spec.task_id, "name": t.spec.name, "state": t.state,
+                     "worker_id": t.worker_id,
+                     "duration_s": (t.ts_end - t.ts_start) if t.ts_end else None}
+                    for t in self.tasks.values()]
+        if kind == "objects":
+            return [{"object_id": o.object_id, "size": o.size, "location": o.location,
+                     "refcount": o.refcount, "pinned": o.pinned}
+                    for o in self.objects.values()]
+        if kind == "workers":
+            return [{"worker_id": w.worker_id, "state": w.state, "pid": w.pid,
+                     "actor_id": w.actor_id, "running": len(w.running)}
+                    for w in self.workers.values()]
+        if kind == "nodes":
+            return [{"node_id": self.node_id, "alive": True, "resources": dict(self.total),
+                     "available": dict(self.available), "object_store_used": self.store_used,
+                     "object_store_capacity": self.store_capacity}]
+        raise ValueError(f"unknown state kind {kind}")

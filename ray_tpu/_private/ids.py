@@ -1,0 +1,44 @@
+"""Compact binary IDs (reference: src/ray/common/id.h, python/ray/includes/unique_ids.pxi).
+
+The reference uses 28-byte task ids / 20-byte object ids with embedded
+job/actor info. We use 16 random bytes rendered as hex — collision-safe for a
+single-controller deployment — plus a monotonic index for readable ordering in
+traces.
+"""
+
+import itertools
+import os
+
+_counter = itertools.count()
+
+
+def new_id(prefix: str) -> str:
+    return f"{prefix}-{next(_counter):06d}-{os.urandom(8).hex()}"
+
+
+def task_id() -> str:
+    return new_id("task")
+
+
+def object_id() -> str:
+    return new_id("obj")
+
+
+def actor_id() -> str:
+    return new_id("actor")
+
+
+def worker_id() -> str:
+    return new_id("worker")
+
+
+def node_id() -> str:
+    return new_id("node")
+
+
+def group_id() -> str:
+    return new_id("pg")
+
+
+def job_id() -> str:
+    return new_id("job")

@@ -1,0 +1,94 @@
+"""ObjectRef — the distributed future (reference: python/ray/includes/object_ref.pxi).
+
+Pickleable: serializes to its id; on deserialization it binds to the current
+process's runtime client (driver or worker). Only the original driver-side ref
+participates in refcounting (`_owned`); refs reconstructed in workers are
+borrows, matching the reference's owner/borrower split
+(src/ray/core_worker/reference_count.h) collapsed to the single-owner case.
+"""
+
+
+class ObjectRef:
+    __slots__ = ("id", "_owned", "__weakref__")
+
+    def __init__(self, object_id: str, owned: bool = False):
+        self.id = object_id
+        self._owned = owned
+
+    def __reduce__(self):
+        return (_rebuild_ref, (self.id,))
+
+    def hex(self) -> str:
+        return self.id
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id})"
+
+    def future(self):
+        """A concurrent.futures.Future resolving to the object's value."""
+        from . import state
+        return state.global_client().as_future(self)
+
+    def __await__(self):
+        # usable in asyncio code (serve handles, async actors)
+        import asyncio
+        fut = self.future()
+        return asyncio.wrap_future(fut).__await__()
+
+    def __del__(self):
+        if self._owned:
+            try:
+                from . import state
+                client = state.global_client_or_none()
+                if client is not None:
+                    client.decref(self.id)
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
+
+
+def _rebuild_ref(object_id: str):
+    return ObjectRef(object_id, owned=False)
+
+
+class ObjectRefGenerator:
+    """Streaming generator handle (ref: python/ray/_raylet.pyx
+    ObjectRefGenerator). Iterates ObjectRefs for values yielded by a
+    `num_returns="streaming"` task as they become available."""
+
+    def __init__(self, task_id: str):
+        self.task_id = task_id
+        self._index = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> ObjectRef:
+        from . import state
+        oid = state.global_client().next_stream_item(self.task_id, self._index)
+        if oid is None:
+            raise StopIteration
+        self._index += 1
+        return ObjectRef(oid, owned=True)
+
+    def __aiter__(self):
+        return self
+
+    async def __anext__(self):
+        import asyncio
+        loop = asyncio.get_running_loop()
+        try:
+            return await loop.run_in_executor(None, self.__next__)
+        except StopIteration:
+            raise StopAsyncIteration from None
+
+    def __reduce__(self):
+        return (ObjectRefGenerator, (self.task_id,))
+
+
+DynamicObjectRefGenerator = ObjectRefGenerator

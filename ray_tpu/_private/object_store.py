@@ -1,0 +1,162 @@
+"""Shared-memory object store client.
+
+Reference: Ray's plasma store (src/ray/object_manager/plasma) — a C++ daemon
+owning one big shm mapping with a slab allocator; clients Create/Seal/Get by
+object id and map buffers zero-copy.
+
+TPU-native rethink: on a TPU host the store's job is (a) zero-copy host-side
+handoff between controller/workers and (b) staging host buffers that
+`jax.device_put` uploads to HBM. We keep plasma's *protocol* (create → seal →
+get by id, eviction, spill-to-disk) but implement each object as its own POSIX
+shm segment (`/dev/shm/rtpu-<id>`), so any process attaches by name with no
+daemon round-trip. Allocation policy/accounting lives in the controller's
+object table; an optional C++ slab store (src/shm_store.cpp) backs
+high-churn small objects.
+"""
+
+import os
+from multiprocessing import shared_memory, resource_tracker
+
+from . import serialization
+
+_SPILL_DIR = "/tmp/ray_tpu_spill"
+
+# The stdlib resource_tracker assumes whoever creates a segment owns cleanup;
+# our segments outlive their creator (controller manages lifetime), which
+# makes the tracker double-unlink and spam KeyErrors (bpo-38119 behavior).
+# Exclude our namespace from tracking entirely.
+_orig_register = resource_tracker.register
+_orig_unregister = resource_tracker.unregister
+
+
+def _filtered_register(name, rtype):
+    if rtype == "shared_memory" and "/rtpu-" in name:
+        return
+    _orig_register(name, rtype)
+
+
+def _filtered_unregister(name, rtype):
+    if rtype == "shared_memory" and "/rtpu-" in name:
+        return
+    _orig_unregister(name, rtype)
+
+
+resource_tracker.register = _filtered_register
+resource_tracker.unregister = _filtered_unregister
+
+
+def _unregister(shm):
+    pass  # tracking already suppressed for the rtpu namespace
+
+
+def seg_name(object_id: str) -> str:
+    # shm names are limited (~31 chars portable); object ids are longer, so use
+    # the stable unique suffix.
+    return "rtpu-" + object_id[-16:]
+
+
+class LocalObject:
+    """A deserialized-on-demand handle pinning its shm segment."""
+
+    __slots__ = ("shm", "value", "nbytes")
+
+    def __init__(self, shm, value, nbytes):
+        self.shm = shm
+        self.value = value
+        self.nbytes = nbytes
+
+
+class StoreClient:
+    """Per-process store client. Thread-safe for CPython practical purposes."""
+
+    def __init__(self):
+        self._attached = {}  # object_id -> LocalObject (pins shm while in use)
+
+    # -- write path ---------------------------------------------------------
+    def put(self, object_id: str, obj) -> int:
+        """Serialize obj into a fresh shm segment. Returns byte size."""
+        meta, buffers = serialization.dumps_oob(obj)
+        return self.put_parts(object_id, meta, buffers)
+
+    def put_parts(self, object_id: str, meta: bytes, buffers) -> int:
+        size = serialization.total_size(meta, buffers)
+        shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True, size=max(size, 1))
+        _unregister(shm)
+        mv = shm.buf
+        mv[: len(meta)] = meta
+        pos = len(meta)
+        for b in buffers:
+            mv[pos : pos + b.nbytes] = b
+            pos += b.nbytes
+        shm.close()
+        return size
+
+    def put_raw(self, object_id: str, blob: bytes) -> int:
+        """Store pre-packed bytes (used when restoring spilled objects)."""
+        shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True, size=max(len(blob), 1))
+        _unregister(shm)
+        shm.buf[: len(blob)] = blob
+        shm.close()
+        return len(blob)
+
+    # -- read path ----------------------------------------------------------
+    def get(self, object_id: str, meta_len: int):
+        """Attach and deserialize; buffers alias the segment (zero-copy)."""
+        cached = self._attached.get(object_id)
+        if cached is not None:
+            return cached.value
+        shm = shared_memory.SharedMemory(name=seg_name(object_id))
+        _unregister(shm)
+        mv = shm.buf
+        value = serialization.loads_oob(mv[:meta_len], mv[meta_len:])
+        self._attached[object_id] = LocalObject(shm, value, mv.nbytes)
+        return value
+
+    def read_raw(self, object_id: str) -> bytes:
+        shm = shared_memory.SharedMemory(name=seg_name(object_id))
+        _unregister(shm)
+        data = bytes(shm.buf)
+        shm.close()
+        return data
+
+    def release(self, object_id: str):
+        loc = self._attached.pop(object_id, None)
+        if loc is not None:
+            loc.value = None
+            try:
+                loc.shm.close()
+            except BufferError:
+                # numpy views still alive; re-pin until they die.
+                self._attached[object_id] = loc
+
+    def delete_segment(self, object_id: str):
+        """Unlink the segment (controller-side eviction)."""
+        self.release(object_id)
+        try:
+            shm = shared_memory.SharedMemory(name=seg_name(object_id))
+            _unregister(shm)
+            shm.close()
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+    # -- spilling ------------------------------------------------------------
+    def spill(self, object_id: str) -> str:
+        """Copy segment to disk and unlink it. Returns the spill path."""
+        os.makedirs(_SPILL_DIR, exist_ok=True)
+        path = os.path.join(_SPILL_DIR, seg_name(object_id))
+        data = self.read_raw(object_id)
+        with open(path, "wb") as f:
+            f.write(data)
+        self.delete_segment(object_id)
+        return path
+
+    def restore(self, object_id: str, path: str) -> int:
+        with open(path, "rb") as f:
+            blob = f.read()
+        os.remove(path)
+        return self.put_raw(object_id, blob)
+
+    def close(self):
+        for oid in list(self._attached):
+            self.release(oid)

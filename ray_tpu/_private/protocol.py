@@ -1,0 +1,58 @@
+"""Length-prefixed pickle framing over unix sockets.
+
+Reference: Ray's control plane is gRPC (src/ray/rpc, src/ray/protobuf). For a
+single-host controller a unix socket with pickle framing has lower latency and
+zero codegen; the message *vocabulary* mirrors the reference's core-worker ↔
+raylet ↔ GCS RPCs (SubmitTask, PushTask reply, WaitForObjectEviction, ...).
+
+Frame: u32 little-endian length | pickle payload. Messages are (kind, dict).
+"""
+
+import pickle
+import struct
+
+_HDR = struct.Struct("<I")
+
+
+def send_msg(sock, kind: str, **payload):
+    data = pickle.dumps((kind, payload), protocol=5)
+    sock.sendall(_HDR.pack(len(data)) + data)
+
+
+def recv_msg(sock):
+    hdr = _recv_exact(sock, 4)
+    if hdr is None:
+        return None
+    (n,) = _HDR.unpack(hdr)
+    data = _recv_exact(sock, n)
+    if data is None:
+        return None
+    return pickle.loads(data)
+
+
+def _recv_exact(sock, n):
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            return None
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+# -- asyncio side (controller) ---------------------------------------------
+
+async def aread_msg(reader):
+    try:
+        hdr = await reader.readexactly(4)
+        (n,) = _HDR.unpack(hdr)
+        data = await reader.readexactly(n)
+    except (EOFError, ConnectionResetError, BrokenPipeError, OSError):
+        return None
+    return pickle.loads(data)
+
+
+def awrite_msg(writer, kind: str, **payload):
+    data = pickle.dumps((kind, payload), protocol=5)
+    writer.write(_HDR.pack(len(data)) + data)

@@ -1,0 +1,98 @@
+"""Serialization with zero-copy out-of-band buffers.
+
+Reference: python/ray/_private/serialization.py — Ray serializes with
+cloudpickle protocol 5 and ships large buffers (numpy arrays, arrow blocks)
+out-of-band into plasma so `get()` can map them zero-copy.
+
+We do the same: `dumps_oob` returns (pickle_bytes, [raw buffers]); callers lay
+the buffers into shared memory and `loads_oob` reconstructs with memoryviews
+into that shm — numpy arrays then alias the segment with no copy. jax host
+arrays hand back their device buffers via __array__ and re-upload with
+device_put on the consumer side (the host→HBM hop is the one unavoidable copy
+on TPU).
+"""
+
+import io
+import pickle
+import struct
+
+import cloudpickle
+
+# Buffers below this size get folded in-band: the bookkeeping costs more than
+# the copy.
+_OOB_MIN_BYTES = 4096
+
+
+def dumps_oob(obj):
+    """Serialize to (meta_bytes, list_of_buffers).
+
+    meta_bytes layout: u32 npickle | pickle | (u64 size)*nbuf — self-framing so
+    a single contiguous shm write round-trips.
+    """
+    buffers = []
+
+    def callback(buf):
+        raw = buf.raw()
+        if raw.nbytes < _OOB_MIN_BYTES:
+            return True  # keep small buffers in-band
+        buffers.append(raw)
+        return False
+
+    payload = cloudpickle.dumps(obj, protocol=5, buffer_callback=callback)
+    header = struct.pack("<I", len(payload)) + payload
+    for b in buffers:
+        header += struct.pack("<Q", b.nbytes)
+    return header, buffers
+
+
+def pack(obj) -> bytes:
+    """Serialize to one contiguous bytes blob (for sockets / small objects)."""
+    meta, buffers = dumps_oob(obj)
+    return pack_parts(meta, buffers)
+
+
+def pack_parts(meta: bytes, buffers) -> bytes:
+    out = io.BytesIO()
+    out.write(struct.pack("<I", len(meta)))
+    out.write(meta)
+    for b in buffers:
+        out.write(b)
+    return out.getvalue()
+
+
+def unpack(data) -> object:
+    """Inverse of pack; accepts bytes or memoryview (zero-copy for the latter)."""
+    mv = memoryview(data)
+    (meta_len,) = struct.unpack_from("<I", mv, 0)
+    meta = mv[4 : 4 + meta_len]
+    return loads_oob(meta, mv[4 + meta_len :])
+
+
+def loads_oob(meta, tail) -> object:
+    """Reconstruct from self-framing meta + a memoryview holding the buffers.
+
+    `tail` must start at the first out-of-band buffer. Buffers are passed to
+    pickle as sub-memoryviews — no copies.
+    """
+    mv = memoryview(meta)
+    (npickle,) = struct.unpack_from("<I", mv, 0)
+    payload = mv[4 : 4 + npickle]
+    sizes = []
+    off = 4 + npickle
+    while off < mv.nbytes:
+        (sz,) = struct.unpack_from("<Q", mv, off)
+        sizes.append(sz)
+        off += 8
+    bufs = []
+    t = memoryview(tail)
+    pos = 0
+    for sz in sizes:
+        # read-only: consumers alias shared memory (ref: plasma objects are
+        # immutable once sealed)
+        bufs.append(pickle.PickleBuffer(t[pos : pos + sz].toreadonly()))
+        pos += sz
+    return pickle.loads(payload, buffers=bufs)
+
+
+def total_size(meta: bytes, buffers) -> int:
+    return len(meta) + sum(b.nbytes for b in buffers)

@@ -1,0 +1,31 @@
+"""Process-global runtime state: which client am I (driver or worker)?
+
+Reference: python/ray/_private/worker.py's `global_worker` singleton.
+"""
+
+_client = None
+_worker_state = None
+
+
+def set_worker_state(ws):
+    global _worker_state
+    _worker_state = ws
+
+
+def worker_state():
+    return _worker_state
+
+
+def set_global_client(client):
+    global _client
+    _client = client
+
+
+def global_client():
+    if _client is None:
+        raise RuntimeError("ray_tpu is not initialized; call ray_tpu.init() first.")
+    return _client
+
+
+def global_client_or_none():
+    return _client

@@ -1,0 +1,70 @@
+"""Task/actor specs shipped controller↔worker.
+
+Reference: src/ray/common/task/task_spec.h (TaskSpecification) — function
+descriptor, args (inline value or ObjectRef), resource demands, retry policy,
+actor info. Same shape here, as a plain pickleable dataclass.
+"""
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+
+# arg encodings: ("v", <packed bytes>) inline value | ("ref", object_id)
+Arg = Tuple[str, Any]
+
+
+@dataclass
+class TaskSpec:
+    task_id: str
+    fn_blob: Optional[bytes]  # cloudpickled callable (None for actor methods)
+    args: List[Arg] = field(default_factory=list)
+    kwargs: Dict[str, Arg] = field(default_factory=dict)
+    num_returns: Any = 1  # int or "streaming"
+    resources: Dict[str, float] = field(default_factory=dict)
+    max_retries: int = 3
+    retry_exceptions: bool = False
+    name: str = ""
+    # actor fields
+    actor_id: Optional[str] = None          # method call target
+    method_name: Optional[str] = None
+    is_actor_creation: bool = False
+    # scheduling
+    scheduling_strategy: Any = None          # None | "SPREAD" | PG strategy
+    placement_group_id: Optional[str] = None
+    placement_group_bundle_index: int = -1
+    # runtime env (env_vars only in round 1)
+    runtime_env: Optional[dict] = None
+    # streaming generators
+    generator_backpressure: int = 0
+    # provenance
+    parent_task_id: Optional[str] = None
+    job_id: Optional[str] = None
+
+
+@dataclass
+class ActorCreationOptions:
+    max_restarts: int = 0
+    max_task_retries: int = 0
+    max_concurrency: int = 1
+    max_pending_calls: int = -1
+    name: Optional[str] = None
+    namespace: Optional[str] = None
+    lifetime: Optional[str] = None  # None | "detached"
+    resources: Dict[str, float] = field(default_factory=dict)
+
+
+@dataclass
+class ObjectMeta:
+    """Controller-side object table entry (ref: src/ray/gcs object table +
+    plasma entry). location: 'shm' | 'inline' | 'spilled'."""
+
+    object_id: str
+    size: int = 0
+    meta_len: int = 0            # header length inside the shm segment
+    location: str = "pending"
+    inline_value: Optional[bytes] = None
+    spill_path: Optional[str] = None
+    refcount: int = 1            # driver/borrower refs; 0 → evictable
+    pinned: int = 0              # in-flight task args pin objects
+    error: Optional[Exception] = None
+    creating_task: Optional[str] = None

@@ -1,0 +1,169 @@
+"""Worker process entrypoint (reference: python/ray/_private/workers/default_worker.py).
+
+Execution model: the controller dispatches up to `max_concurrency` exec
+messages at once; a small thread pool runs them. Async actor methods run on a
+persistent asyncio loop so `await` concurrency works like the reference's
+async actors (python/ray/_private/async_compat.py). jax is never imported
+here — tasks that need it import it themselves, keeping worker cold-start
+~100ms.
+"""
+
+import asyncio
+import inspect
+import sys
+import threading
+import traceback
+from concurrent.futures import ThreadPoolExecutor
+
+import cloudpickle
+
+from .. import exceptions as exc
+from . import ids, serialization, state
+from .client import WorkerClient
+
+
+_ActorExit = exc._ActorExit
+
+
+class WorkerState:
+    def __init__(self, client):
+        self.client = client
+        self.actor_instance = None
+        self.actor_id = None
+        self.fn_cache = {}
+        self.async_loop = None
+        self.current = threading.local()
+
+    def get_async_loop(self):
+        if self.async_loop is None:
+            self.async_loop = asyncio.new_event_loop()
+            t = threading.Thread(target=self.async_loop.run_forever, daemon=True)
+            t.start()
+        return self.async_loop
+
+
+def current_worker():
+    return state.worker_state()
+
+
+def _load_fn(ws, blob):
+    key = hash(blob)
+    fn = ws.fn_cache.get(key)
+    if fn is None:
+        fn = cloudpickle.loads(blob)
+        ws.fn_cache[key] = fn
+    return fn
+
+
+def _resolve_args(ws, spec):
+    """Fetch top-level ObjectRef args (values inline; nested refs stay refs)."""
+    ref_oids = [v for k, v in list(spec.args) + list(spec.kwargs.values()) if k == "ref"]
+    fetched = {}
+    if ref_oids:
+        values = ws.client.get(ref_oids)
+        fetched = dict(zip(ref_oids, values))
+    args = [fetched[v] if k == "ref" else serialization.unpack(v) for k, v in spec.args]
+    kwargs = {name: (fetched[v] if k == "ref" else serialization.unpack(v))
+              for name, (k, v) in spec.kwargs.items()}
+    return args, kwargs
+
+
+def _call(ws, fn, args, kwargs):
+    if inspect.iscoroutinefunction(fn):
+        loop = ws.get_async_loop()
+        return asyncio.run_coroutine_threadsafe(fn(*args, **kwargs), loop).result()
+    return fn(*args, **kwargs)
+
+
+def _execute(ws, p):
+    spec = p["spec"]
+    result_oids = p["result_oids"]
+    ws.client.current_task_id = spec.task_id
+    ws.current.spec = spec
+    error = None
+    results = []
+    try:
+        args, kwargs = _resolve_args(ws, spec)
+        if spec.is_actor_creation:
+            cls = _load_fn(ws, spec.fn_blob)
+            ws.actor_instance = cls(*args, **kwargs)
+            ws.actor_id = spec.actor_id
+            results = [ws.client.put_result(result_oids[0], None)]
+        else:
+            if spec.actor_id is not None:
+                fn = getattr(ws.actor_instance, spec.method_name)
+            else:
+                fn = _load_fn(ws, spec.fn_blob)
+            out = _call(ws, fn, args, kwargs)
+            if spec.num_returns == "streaming":
+                results = [_drain_generator(ws, spec, result_oids[0], out)]
+            elif spec.num_returns == 1:
+                results = [ws.client.put_result(result_oids[0], out)]
+            else:
+                seq = tuple(out)
+                if len(seq) != spec.num_returns:
+                    raise ValueError(
+                        f"task declared num_returns={spec.num_returns} but returned "
+                        f"{len(seq)} values")
+                results = [ws.client.put_result(oid, v) for oid, v in zip(result_oids, seq)]
+    except _ActorExit:
+        ws.client.notify_actor_exit(ws.actor_id)
+        ws.client._send("task_done", task_id=spec.task_id, results=[], error=None)
+        sys.exit(0)
+    except KeyboardInterrupt:
+        error = exc.TaskCancelledError(spec.task_id)
+    except BaseException as e:  # noqa: BLE001 - full fidelity to the caller
+        tb = traceback.format_exc()
+        error = exc.TaskError(spec.name or str(spec.method_name or "task"), tb, e)
+    finally:
+        ws.client.current_task_id = None
+    ws.client._send("task_done", task_id=spec.task_id, results=results, error=error)
+
+
+def _drain_generator(ws, spec, handle_oid, gen):
+    """Stream yielded values as they materialize (ref: _raylet.pyx
+    execute_streaming_generator)."""
+    item_oids = []
+    if inspect.isasyncgen(gen):
+        loop = ws.get_async_loop()
+
+        async def drain():
+            out = []
+            async for item in gen:
+                out.append(_emit(ws, spec, item))
+            return out
+
+        item_oids = asyncio.run_coroutine_threadsafe(drain(), loop).result()
+    else:
+        for item in gen:
+            item_oids.append(_emit(ws, spec, item))
+    return ws.client.put_result(handle_oid, item_oids)
+
+
+def _emit(ws, spec, item):
+    oid = ids.object_id()
+    _, meta_len, size, inline = ws.client.put_result(oid, item)
+    ws.client._send("stream_item", task_id=spec.task_id, oid=oid,
+                    meta_len=meta_len, size=size, inline=inline)
+    return oid
+
+
+def main():
+    socket_path, worker_id = sys.argv[1], sys.argv[2]
+    client = WorkerClient(socket_path, worker_id)
+    state.set_global_client(client)
+    ws = WorkerState(client)
+    state.set_worker_state(ws)
+    pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="rtpu-exec")
+    while True:
+        with client.task_available:
+            while not client.task_queue:
+                client.task_available.wait()
+            p = client.task_queue.pop(0)
+        if p is None:
+            break
+        pool.submit(_execute, ws, p)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,154 @@
+"""Actor API (reference: python/ray/actor.py — ActorClass / ActorHandle /
+ActorMethod).
+
+An actor is a dedicated worker process holding instance state; method calls
+are ordered per-actor (FIFO) up to `max_concurrency`. Handles are pickleable
+and can be passed into tasks/other actors.
+"""
+
+import cloudpickle
+
+from ._private import ids, state
+from ._private.object_ref import ObjectRef, ObjectRefGenerator
+from ._private.task_spec import ActorCreationOptions, TaskSpec
+from .remote_function import encode_call, _normalize_resources
+
+
+def method(**options):
+    """Decorator for actor methods: @method(num_returns=2) (ref:
+    python/ray/actor.py:method)."""
+
+    def decorate(fn):
+        fn.__rtpu_method_options__ = options
+        return fn
+
+    return decorate
+
+
+def exit_actor():
+    """Terminate the current actor gracefully (ref: ray.actor.exit_actor)."""
+    from .exceptions import _ActorExit
+    raise _ActorExit()
+
+
+class ActorMethod:
+    def __init__(self, handle, name, num_returns=1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def options(self, **overrides):
+        return ActorMethod(self._handle, self._name,
+                           overrides.get("num_returns", self._num_returns))
+
+    def remote(self, *args, **kwargs):
+        return self._handle._invoke(self._name, args, kwargs, self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(f"Actor method '{self._name}' must be called with .remote().")
+
+
+class ActorHandle:
+    def __init__(self, actor_id, method_meta, name=""):
+        self._actor_id = actor_id
+        self._method_meta = method_meta  # {name: {"num_returns": n}}
+        self._name = name
+
+    def __getattr__(self, item):
+        meta = self._method_meta.get(item)
+        if meta is None:
+            raise AttributeError(f"Actor has no method '{item}'")
+        return ActorMethod(self, item, meta.get("num_returns", 1))
+
+    def _invoke(self, method_name, args, kwargs, num_returns):
+        client = state.global_client()
+        eargs, ekwargs = encode_call(args, kwargs)
+        spec = TaskSpec(
+            task_id=ids.task_id(),
+            fn_blob=None,
+            args=eargs,
+            kwargs=ekwargs,
+            num_returns=num_returns,
+            resources={},
+            max_retries=0,
+            name=f"{self._name or self._actor_id}.{method_name}",
+            actor_id=self._actor_id,
+            method_name=method_name,
+            job_id=client.job_id,
+        )
+        oids = client.submit(spec)
+        if num_returns == "streaming":
+            return ObjectRefGenerator(spec.task_id)
+        refs = [ObjectRef(oid, owned=True) for oid in oids]
+        return refs[0] if num_returns == 1 else refs
+
+    def __reduce__(self):
+        return (ActorHandle, (self._actor_id, self._method_meta, self._name))
+
+    def __repr__(self):
+        return f"ActorHandle({self._actor_id})"
+
+
+class ActorClass:
+    def __init__(self, cls, **options):
+        self._cls = cls
+        self._options = options
+        self._blob = None
+        self.__name__ = getattr(cls, "__name__", "Actor")
+
+    def options(self, **overrides):
+        merged = {**self._options, **overrides}
+        ac = ActorClass(self._cls, **merged)
+        ac._blob = self._blob
+        return ac
+
+    def __call__(self, *a, **k):
+        raise TypeError(f"Actor class '{self.__name__}' cannot be instantiated "
+                        f"directly; use '{self.__name__}.remote()'.")
+
+    def _method_meta(self):
+        meta = {}
+        for attr in dir(self._cls):
+            if attr.startswith("__"):
+                continue
+            fn = getattr(self._cls, attr, None)
+            if callable(fn):
+                opts = getattr(fn, "__rtpu_method_options__", {})
+                meta[attr] = {"num_returns": opts.get("num_returns", 1)}
+        return meta
+
+    def remote(self, *args, **kwargs):
+        client = state.global_client()
+        opts = self._options
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._cls)
+        # actors default to holding 0 CPUs while alive (ref: ray defaults —
+        # 1 CPU for placement, 0 for running); explicit num_cpus is held.
+        res = _normalize_resources({**opts, "num_cpus": opts.get("num_cpus", 0)})
+        actor_id = ids.actor_id()
+        creation = TaskSpec(
+            task_id=ids.task_id(),
+            fn_blob=self._blob,
+            num_returns=1,
+            resources=res,
+            max_retries=0,
+            name=f"{self.__name__}.__init__",
+            actor_id=actor_id,
+            is_actor_creation=True,
+            runtime_env=opts.get("runtime_env"),
+            job_id=client.job_id,
+        )
+        eargs, ekwargs = encode_call(args, kwargs)
+        creation.args, creation.kwargs = eargs, ekwargs
+        acopts = ActorCreationOptions(
+            max_restarts=opts.get("max_restarts", 0),
+            max_task_retries=opts.get("max_task_retries", 0),
+            max_concurrency=opts.get("max_concurrency", 1),
+            name=opts.get("name"),
+            namespace=opts.get("namespace") or getattr(client, "namespace", None),
+            lifetime=opts.get("lifetime"),
+            resources=res,
+        )
+        client.register_actor(creation, acopts)
+        client.submit(creation)
+        return ActorHandle(actor_id, self._method_meta(), name=opts.get("name") or "")

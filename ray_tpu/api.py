@@ -1,0 +1,242 @@
+"""Top-level API (reference: python/ray/_private/worker.py public functions +
+python/ray/__init__.py exports).
+
+`init()` starts the single-host controller on a background asyncio thread;
+TPU chips are first-class resources ("TPU"), discovered from jax when
+available without forcing a jax import in workers.
+"""
+
+import asyncio
+import atexit
+import inspect
+import os
+import tempfile
+import threading
+
+from ._private import ids, state
+from ._private.client import DriverClient
+from ._private.controller import Controller, DEFAULT_CAPACITY
+from ._private.object_ref import ObjectRef, ObjectRefGenerator
+from .actor import ActorClass, ActorHandle
+from .remote_function import RemoteFunction
+from . import exceptions as exc
+
+_runtime = None
+_lock = threading.Lock()
+
+
+class _Runtime:
+    def __init__(self, controller, loop, thread, client, namespace):
+        self.controller = controller
+        self.loop = loop
+        self.thread = thread
+        self.client = client
+        self.namespace = namespace
+
+
+def _detect_tpus():
+    """Chip count without importing jax in this process if possible."""
+    env = os.environ.get("RAY_TPU_NUM_CHIPS")
+    if env is not None:
+        return int(env)
+    try:
+        import jax
+        return sum(1 for d in jax.devices() if d.platform not in ("cpu",))
+    except Exception:  # noqa: BLE001 - jax missing/unconfigured → no TPU resource
+        return 0
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
+         object_store_memory=None, ignore_reinit_error=False, max_workers=None,
+         **_compat):
+    """Start the ray_tpu runtime in this process (the driver).
+
+    Unrecognized reference kwargs (address, dashboard_*, logging_*) are
+    accepted and ignored for drop-in compatibility.
+    """
+    global _runtime
+    with _lock:
+        if _runtime is not None:
+            if ignore_reinit_error:
+                return
+            raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True.")
+        total = dict(resources or {})
+        total["CPU"] = float(num_cpus if num_cpus is not None else max(os.cpu_count(), 4))
+        ntpu = num_tpus if num_tpus is not None else _detect_tpus()
+        if ntpu:
+            total["TPU"] = float(ntpu)
+        total.setdefault("memory", 64 << 30)
+        sock = os.path.join(tempfile.gettempdir(), f"rtpu-{os.getpid()}-{ids.new_id('s')[-8:]}.sock")
+        controller = Controller(
+            sock, total, job_id=ids.job_id(),
+            max_workers=max_workers,
+            store_capacity=object_store_memory or DEFAULT_CAPACITY)
+
+        loop = asyncio.new_event_loop()
+        started = threading.Event()
+
+        def run():
+            asyncio.set_event_loop(loop)
+            loop.run_until_complete(controller.start())
+            started.set()
+            loop.run_forever()
+
+        thread = threading.Thread(target=run, daemon=True, name="rtpu-controller")
+        thread.start()
+        started.wait(10)
+        client = DriverClient(controller, loop)
+        client.namespace = namespace or "default"
+        state.set_global_client(client)
+        _runtime = _Runtime(controller, loop, thread, client, namespace or "default")
+        atexit.register(shutdown)
+        return
+
+
+def shutdown():
+    global _runtime
+    with _lock:
+        if _runtime is None:
+            return
+        rt, _runtime = _runtime, None
+        try:
+            fut = asyncio.run_coroutine_threadsafe(rt.controller.shutdown(), rt.loop)
+            fut.result(10)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        def _stop():
+            for t in asyncio.all_tasks(rt.loop):
+                t.cancel()
+            rt.loop.call_soon(rt.loop.stop)
+
+        rt.loop.call_soon_threadsafe(_stop)
+        rt.thread.join(5)
+        state.set_global_client(None)
+
+
+def _ensure_init():
+    # auto-init only in a bare driver; workers already carry a WorkerClient
+    if state.global_client_or_none() is None:
+        init()
+
+
+def remote(*args, **options):
+    """@remote decorator for functions and classes (ref:
+    python/ray/_private/worker.py:remote)."""
+
+    def wrap(target):
+        if inspect.isclass(target):
+            return ActorClass(target, **options)
+        return RemoteFunction(target, **options)
+
+    if len(args) == 1 and callable(args[0]) and not options:
+        return wrap(args[0])
+    if args:
+        raise TypeError("@remote takes keyword options only, e.g. @remote(num_tpus=1)")
+    return wrap
+
+
+def get(refs, *, timeout=None):
+    _ensure_init()
+    client = state.global_client()
+    if isinstance(refs, ObjectRef):
+        return client.get([refs.id], timeout=timeout)[0]
+    if isinstance(refs, ObjectRefGenerator):
+        raise TypeError("get() on a streaming generator; iterate it instead.")
+    if not isinstance(refs, (list, tuple)):
+        raise TypeError(f"get() expects ObjectRef or list, got {type(refs)}")
+    for r in refs:
+        if not isinstance(r, ObjectRef):
+            raise TypeError(f"get() list elements must be ObjectRef, got {type(r)}")
+    if not refs:
+        return []
+    return client.get([r.id for r in refs], timeout=timeout)
+
+
+def put(value) -> ObjectRef:
+    _ensure_init()
+    if isinstance(value, ObjectRef):
+        raise TypeError("put() of an ObjectRef is not allowed (matches reference).")
+    return ObjectRef(state.global_client().put(value), owned=True)
+
+
+def wait(refs, *, num_returns=1, timeout=None, fetch_local=True):
+    _ensure_init()
+    if isinstance(refs, ObjectRef):
+        raise TypeError("wait() expects a list of ObjectRefs.")
+    if num_returns > len(refs):
+        raise ValueError(f"num_returns={num_returns} > len(refs)={len(refs)}")
+    by_id = {r.id: r for r in refs}
+    ready_ids, rest_ids = state.global_client().wait(
+        [r.id for r in refs], num_returns, timeout)
+    return [by_id[i] for i in ready_ids], [by_id[i] for i in rest_ids]
+
+
+def cancel(ref, *, force=False, recursive=True):
+    _ensure_init()
+    target = ref.id if isinstance(ref, ObjectRef) else str(ref)
+    state.global_client().cancel(target, force=force)
+
+
+def kill(actor, *, no_restart=True):
+    _ensure_init()
+    if not isinstance(actor, ActorHandle):
+        raise TypeError("kill() expects an ActorHandle; use cancel() for tasks.")
+    state.global_client().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def get_actor(name, namespace=None) -> ActorHandle:
+    _ensure_init()
+    client = state.global_client()
+    actor_id = client.get_actor(name, namespace or getattr(client, "namespace", None))
+    # method metadata lives with the creating driver; reconstruct lazily
+    meta = _actor_method_meta(actor_id)
+    return ActorHandle(actor_id, meta, name=name)
+
+
+def _actor_method_meta(actor_id):
+    client = state.global_client()
+    if getattr(client, "is_driver", False):
+        actor = client.controller.actors.get(actor_id)
+        if actor is not None and actor.creation_spec is not None:
+            import cloudpickle
+            cls = cloudpickle.loads(actor.creation_spec.fn_blob)
+            return ActorClass(cls)._method_meta()
+    return _AnyMethodMeta()
+
+
+class _AnyMethodMeta(dict):
+    """Workers can't read the controller's class blob cheaply; allow any
+    method name and let the actor-side getattr fail loudly."""
+
+    def get(self, key, default=None):
+        return {"num_returns": 1}
+
+
+def available_resources():
+    _ensure_init()
+    return state.global_client().resources()[1]
+
+
+def cluster_resources():
+    _ensure_init()
+    return state.global_client().resources()[0]
+
+
+def nodes():
+    _ensure_init()
+    return state.global_client().state("nodes")
+
+
+def timeline(filename=None):
+    """Chrome-trace task timeline (ref: ray.timeline)."""
+    _ensure_init()
+    events = state.global_client().timeline()
+    if filename:
+        import json
+        with open(filename, "w") as f:
+            json.dump(events, f)
+    return events

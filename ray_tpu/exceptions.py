@@ -1,0 +1,110 @@
+"""Exception types for ray_tpu.
+
+Parity with ray.exceptions (reference: python/ray/exceptions.py): RayError →
+RayTaskError / RayActorError / GetTimeoutError / ObjectLostError, etc. We keep
+the same semantic surface under TPU-native names, with `Ray*` aliases so code
+written against the reference API ports over unchanged.
+"""
+
+
+class RayTpuError(Exception):
+    """Base class for all ray_tpu errors."""
+
+
+class TaskError(RayTpuError):
+    """A task raised an exception during execution.
+
+    Mirrors ray.exceptions.RayTaskError: wraps the original traceback string
+    and re-raises at `get()` on the caller side (reference:
+    python/ray/exceptions.py:RayTaskError.as_instanceof_cause).
+    """
+
+    def __init__(self, function_name: str, traceback_str: str, cause: Exception = None):
+        self.function_name = function_name
+        self.traceback_str = traceback_str
+        self.cause = cause
+        super().__init__(f"task {function_name} failed:\n{traceback_str}")
+
+    def __reduce__(self):
+        # cause may be unpicklable user junk; ship it best-effort
+        try:
+            import cloudpickle
+            cloudpickle.dumps(self.cause)
+            cause = self.cause
+        except Exception:  # noqa: BLE001
+            cause = None
+        return (TaskError, (self.function_name, self.traceback_str, cause))
+
+
+class ActorError(RayTpuError):
+    """Base for actor-related failures (ray.exceptions.RayActorError)."""
+
+
+class ActorDiedError(ActorError):
+    """The actor died (process exit/crash) before or during a method call."""
+
+    def __init__(self, actor_id: str = "", reason: str = "actor died"):
+        self.actor_id = actor_id
+        self.reason = reason
+        super().__init__(f"Actor {actor_id} is dead: {reason}")
+
+    def __reduce__(self):
+        return (ActorDiedError, (self.actor_id, self.reason))
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is temporarily unreachable (restarting)."""
+
+
+class GetTimeoutError(RayTpuError, TimeoutError):
+    """`get()` timed out (ray.exceptions.GetTimeoutError)."""
+
+
+class ObjectLostError(RayTpuError):
+    """Object was evicted/lost and could not be reconstructed."""
+
+    def __init__(self, object_id: str = ""):
+        self.object_id = object_id
+        super().__init__(f"Object {object_id} was lost (evicted or owner died).")
+
+    def __reduce__(self):
+        return (ObjectLostError, (self.object_id,))
+
+
+class ObjectStoreFullError(RayTpuError):
+    """Object store is out of memory and nothing could be spilled."""
+
+
+class TaskCancelledError(RayTpuError):
+    """Task was cancelled via cancel() (ray.exceptions.TaskCancelledError)."""
+
+    def __init__(self, task_id: str = ""):
+        self.task_id = task_id
+        super().__init__(f"Task {task_id} was cancelled.")
+
+    def __reduce__(self):
+        return (TaskCancelledError, (self.task_id,))
+
+
+class WorkerCrashedError(RayTpuError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class RuntimeEnvSetupError(RayTpuError):
+    """Failed to set up the runtime environment for a task/actor."""
+
+
+class PendingCallsLimitExceeded(RayTpuError):
+    """Actor's pending-call queue limit (max_pending_calls) exceeded."""
+
+
+class _ActorExit(BaseException):
+    """Internal: raised by exit_actor(); BaseException so user `except
+    Exception` blocks can't swallow it (ref: ray.actor.exit_actor uses
+    SystemExit the same way)."""
+
+
+# Aliases matching the reference's names, so `except ray.exceptions.X` maps 1:1.
+RayError = RayTpuError
+RayTaskError = TaskError
+RayActorError = ActorError

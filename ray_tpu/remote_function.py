@@ -1,0 +1,95 @@
+"""RemoteFunction — the `@ray_tpu.remote` task wrapper.
+
+Reference: python/ray/remote_function.py. `.remote()` builds a TaskSpec and
+submits; `.options()` returns a shallow override wrapper, same semantics.
+"""
+
+import cloudpickle
+
+from ._private import ids, serialization, state
+from ._private.object_ref import ObjectRef, ObjectRefGenerator
+from ._private.task_spec import TaskSpec
+
+_DEFAULT_TASK_CPUS = 1.0
+
+
+def _normalize_resources(opts) -> dict:
+    res = dict(opts.get("resources") or {})
+    num_cpus = opts.get("num_cpus")
+    res["CPU"] = _DEFAULT_TASK_CPUS if num_cpus is None else float(num_cpus)
+    if opts.get("num_tpus"):
+        res["TPU"] = float(opts["num_tpus"])
+    if opts.get("num_gpus"):
+        # accepted for API parity; a TPU cluster has no CUDA devices, so this
+        # schedules against a "GPU" custom resource if the user registered one
+        res["GPU"] = float(opts["num_gpus"])
+    return {k: v for k, v in res.items() if v}
+
+
+def encode_arg(value):
+    if isinstance(value, ObjectRef):
+        return ("ref", value.id)
+    return ("v", serialization.pack(value))
+
+
+def encode_call(args, kwargs):
+    return [encode_arg(a) for a in args], {k: encode_arg(v) for k, v in (kwargs or {}).items()}
+
+
+class RemoteFunction:
+    def __init__(self, fn, **options):
+        self._fn = fn
+        self._options = options
+        self._blob = None
+        self.__name__ = getattr(fn, "__name__", "remote_fn")
+        self.__doc__ = getattr(fn, "__doc__", None)
+
+    def _get_blob(self):
+        if self._blob is None:
+            self._blob = cloudpickle.dumps(self._fn)
+        return self._blob
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Remote function '{self.__name__}' cannot be called directly; use "
+            f"'{self.__name__}.remote()'.")
+
+    def options(self, **overrides):
+        merged = {**self._options, **overrides}
+        rf = RemoteFunction(self._fn, **merged)
+        rf._blob = self._blob
+        return rf
+
+    def remote(self, *args, **kwargs):
+        client = state.global_client()
+        opts = self._options
+        num_returns = opts.get("num_returns", 1)
+        eargs, ekwargs = encode_call(args, kwargs)
+        spec = TaskSpec(
+            task_id=ids.task_id(),
+            fn_blob=self._get_blob(),
+            args=eargs,
+            kwargs=ekwargs,
+            num_returns=num_returns,
+            resources=_normalize_resources(opts),
+            max_retries=opts.get("max_retries", 3),
+            retry_exceptions=bool(opts.get("retry_exceptions", False)),
+            name=opts.get("name") or self.__name__,
+            scheduling_strategy=opts.get("scheduling_strategy"),
+            runtime_env=opts.get("runtime_env"),
+            job_id=client.job_id,
+        )
+        _apply_scheduling_strategy(spec, opts.get("scheduling_strategy"))
+        oids = client.submit(spec)
+        if num_returns == "streaming":
+            return ObjectRefGenerator(spec.task_id)
+        refs = [ObjectRef(oid, owned=True) for oid in oids]
+        return refs[0] if num_returns == 1 else refs
+
+
+def _apply_scheduling_strategy(spec: TaskSpec, strategy):
+    # PlacementGroupSchedulingStrategy → bundle reservation accounting
+    from .util.scheduling_strategies import PlacementGroupSchedulingStrategy
+    if isinstance(strategy, PlacementGroupSchedulingStrategy) and strategy.placement_group:
+        spec.placement_group_id = strategy.placement_group.id
+        spec.placement_group_bundle_index = strategy.placement_group_bundle_index or 0

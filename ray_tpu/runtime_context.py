@@ -1,0 +1,67 @@
+"""Runtime context (reference: python/ray/runtime_context.py).
+
+`get_runtime_context()` works in the driver and inside tasks/actors; TPU chip
+assignment (`get_tpu_ids`) replaces the reference's `get_gpu_ids`
+(python/ray/_private/worker.py:get_gpu_ids).
+"""
+
+import os
+
+from ._private import state
+
+
+class RuntimeContext:
+    def __init__(self, job_id=None, node_id=None, task_id=None, actor_id=None,
+                 tpu_ids=None, worker_id=None):
+        self.job_id = job_id
+        self.node_id = node_id
+        self.task_id = task_id
+        self.actor_id = actor_id
+        self.worker_id = worker_id
+        self._tpu_ids = tpu_ids or []
+
+    def get_job_id(self):
+        return self.job_id
+
+    def get_node_id(self):
+        return self.node_id
+
+    def get_task_id(self):
+        return self.task_id
+
+    def get_actor_id(self):
+        return self.actor_id
+
+    def get_worker_id(self):
+        return self.worker_id
+
+    def get_tpu_ids(self):
+        return list(self._tpu_ids)
+
+    # reference-API alias: GPU slots map onto TPU chips in this framework
+    def get_accelerator_ids(self):
+        return {"TPU": [str(i) for i in self._tpu_ids]}
+
+
+def get_runtime_context() -> RuntimeContext:
+    client = state.global_client()
+    if getattr(client, "is_driver", False):
+        return RuntimeContext(job_id=client.job_id,
+                              node_id=client.controller.node_id)
+    ws = state.worker_state()
+    spec = getattr(ws.current, "spec", None) if ws else None
+    env_tpus = os.environ.get("RAY_TPU_IDS", "")
+    tpu_ids = [int(x) for x in env_tpus.split(",") if x]
+    if spec is not None and spec.runtime_env:
+        tpu_ids = spec.runtime_env.get("_tpu_ids", tpu_ids)
+    return RuntimeContext(
+        job_id=spec.job_id if spec else None,
+        task_id=spec.task_id if spec else None,
+        actor_id=(ws.actor_id if ws else None),
+        worker_id=os.environ.get("RAY_TPU_WORKER_ID"),
+        tpu_ids=tpu_ids,
+    )
+
+
+def get_tpu_ids():
+    return get_runtime_context().get_tpu_ids()

@@ -1,0 +1,27 @@
+"""Utility belt (reference: python/ray/util)."""
+
+from .placement_group import (
+    PlacementGroup,
+    get_current_placement_group,
+    placement_group,
+    remove_placement_group,
+)
+from .scheduling_strategies import (
+    NodeAffinitySchedulingStrategy,
+    PlacementGroupSchedulingStrategy,
+)
+
+__all__ = [
+    "PlacementGroup", "placement_group", "remove_placement_group",
+    "get_current_placement_group", "PlacementGroupSchedulingStrategy",
+    "NodeAffinitySchedulingStrategy",
+]
+
+
+def __getattr__(name):
+    if name in ("ActorPool", "Queue"):
+        import importlib
+        mod = importlib.import_module(".actor_pool" if name == "ActorPool" else ".queue",
+                                      __name__)
+        return getattr(mod, name)
+    raise AttributeError(name)

@@ -1,0 +1,61 @@
+"""Placement groups (reference: python/ray/util/placement_group.py).
+
+Single-host semantics: a bundle is a resource reservation carved out of the
+host pool; PACK/SPREAD/STRICT_* degenerate to the same placement but keep
+their admission-accounting behavior, so code written for the reference runs
+unchanged and becomes multi-host-aware when nodes do (round 2+).
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+from .._private import state
+from .. import exceptions as exc
+
+
+@dataclass
+class PlacementGroup:
+    id: str
+    bundles: List[Dict[str, float]] = field(default_factory=list)
+    strategy: str = "PACK"
+
+    def ready(self):
+        """Returns an ObjectRef resolving when the group is reserved. Our
+        reservation is synchronous, so this is an already-resolved ref."""
+        from ..api import put
+        return put(True)
+
+    def wait(self, timeout_seconds: float = 30) -> bool:
+        return True
+
+    @property
+    def bundle_specs(self):
+        return list(self.bundles)
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id, self.bundles, self.strategy))
+
+
+def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
+                    name: str = "", lifetime=None) -> PlacementGroup:
+    client = state.global_client()
+    deadline = time.monotonic() + 30
+    while True:
+        try:
+            pg_id = client.create_placement_group(bundles, strategy, name)
+            return PlacementGroup(pg_id, list(bundles), strategy)
+        except ValueError:
+            # resources temporarily in use — the reference queues pending PGs;
+            # we poll with a deadline
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.05)
+
+
+def remove_placement_group(pg: PlacementGroup):
+    state.global_client().remove_placement_group(pg.id)
+
+
+def get_current_placement_group():
+    return None  # set inside tasks when capture is implemented (round 2+)

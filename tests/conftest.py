@@ -1,0 +1,21 @@
+"""Test env: virtual 8-device CPU mesh, no TPU dependency (SURVEY.md §4)."""
+
+import os
+
+# Must be set before jax is imported anywhere in the test process.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("RAY_TPU_NUM_CHIPS", "0")
+# workers inherit this env, so jax-in-worker also sees the cpu mesh
+
+import pytest
+
+
+@pytest.fixture(scope="session")
+def ray_session():
+    import ray_tpu
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield ray_tpu
+    ray_tpu.shutdown()

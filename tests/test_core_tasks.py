@@ -1,0 +1,242 @@
+"""Core task API tests (model: python/ray/tests/test_basic.py)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_simple_task(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    assert ray.get(f.remote(1)) == 2
+
+
+def test_many_tasks_parallel(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def sq(x):
+        return x * x
+
+    assert ray.get([sq.remote(i) for i in range(20)]) == [i * i for i in range(20)]
+
+
+def test_task_chaining_refs(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def double(x):
+        return 2 * x
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    assert ray.get(add.remote(double.remote(3), double.remote(4))) == 14
+
+
+def test_put_get_roundtrip(ray_session):
+    ray = ray_session
+    for val in [42, "hello", {"a": [1, 2]}, None, (1, "x")]:
+        assert ray.get(ray.put(val)) == val
+
+
+def test_put_get_numpy_zero_copy(ray_session):
+    ray = ray_session
+    arr = np.random.rand(100_000).astype(np.float32)
+    out = ray.get(ray.put(arr))
+    np.testing.assert_array_equal(out, arr)
+    # zero-copy: the result aliases shared memory, so it's read-only
+    assert not out.flags.writeable
+
+
+def test_task_numpy_arg_and_result(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def scale(a, k):
+        return a * k
+
+    arr = np.arange(50_000, dtype=np.float32)
+    out = ray.get(scale.remote(ray.put(arr), 3.0))
+    np.testing.assert_allclose(out, arr * 3.0)
+
+
+def test_num_returns(ray_session):
+    ray = ray_session
+
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_options_override(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def two():
+        return 1, 2
+
+    a, b = two.options(num_returns=2).remote()
+    assert ray.get([a, b]) == [1, 2]
+
+
+def test_error_propagation(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def boom():
+        raise ValueError("sad")
+
+    with pytest.raises(ray.exceptions.TaskError) as ei:
+        ray.get(boom.remote())
+    assert "sad" in str(ei.value)
+    assert isinstance(ei.value.cause, ValueError)
+
+
+def test_error_through_dependency(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def boom():
+        raise RuntimeError("upstream")
+
+    @ray.remote
+    def consume(x):
+        return x
+
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(consume.remote(boom.remote()))
+
+
+def test_wait_basic(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def fast():
+        return "fast"
+
+    @ray.remote
+    def slow():
+        time.sleep(3)
+        return "slow"
+
+    f_ref, s_ref = fast.remote(), slow.remote()
+    ready, rest = ray.wait([f_ref, s_ref], num_returns=1, timeout=10)
+    assert ready == [f_ref] and rest == [s_ref]
+    ready2, rest2 = ray.wait([s_ref], num_returns=1, timeout=15)
+    assert ready2 == [s_ref]
+
+
+def test_get_timeout(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def hang():
+        time.sleep(30)
+
+    ref = hang.remote()
+    with pytest.raises(ray.exceptions.GetTimeoutError):
+        ray.get(ref, timeout=0.2)
+    ray.cancel(ref, force=True)
+
+
+def test_cancel_pending(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def sleepy(t):
+        time.sleep(t)
+        return t
+
+    # saturate the 4-cpu pool, then cancel a queued task
+    running = [sleepy.remote(2) for _ in range(4)]
+    queued = sleepy.remote(0)
+    ray.cancel(queued)
+    with pytest.raises((ray.exceptions.TaskCancelledError, ray.exceptions.TaskError)):
+        ray.get(queued, timeout=15)
+    ray.get(running)  # drain
+
+
+def test_nested_tasks(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def inner(x):
+        return x * 10
+
+    @ray.remote
+    def outer(x):
+        import ray_tpu
+        return ray_tpu.get(inner.remote(x)) + 1
+
+    assert ray.get(outer.remote(4)) == 41
+
+
+def test_streaming_generator(ray_session):
+    ray = ray_session
+
+    @ray.remote(num_returns="streaming")
+    def gen(n):
+        for i in range(n):
+            yield i * i
+
+    out = [ray.get(r) for r in gen.remote(5)]
+    assert out == [0, 1, 4, 9, 16]
+
+
+def test_retries_on_worker_crash(ray_session):
+    ray = ray_session
+
+    @ray.remote(max_retries=2)
+    def flaky(path):
+        import os
+        if not os.path.exists(path):
+            open(path, "w").close()
+            os._exit(1)  # simulate worker crash on first attempt
+        return "recovered"
+
+    import tempfile
+    path = tempfile.mktemp()
+    assert ray.get(flaky.remote(path), timeout=60) == "recovered"
+
+
+def test_runtime_context_in_task(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def ctx():
+        import ray_tpu
+        c = ray_tpu.get_runtime_context()
+        return c.get_task_id(), c.get_worker_id()
+
+    task_id, worker_id = ray.get(ctx.remote())
+    assert task_id.startswith("task-")
+    assert worker_id.startswith("worker-")
+
+
+def test_cluster_resources(ray_session):
+    ray = ray_session
+    total = ray.cluster_resources()
+    assert total["CPU"] == 4.0
+    avail = ray.available_resources()
+    assert avail["CPU"] <= total["CPU"]
+
+
+def test_large_object_shm(ray_session):
+    ray = ray_session
+    big = np.ones((512, 1024), dtype=np.float32)  # 2MB → shm path
+
+    @ray.remote
+    def total(a):
+        return float(a.sum())
+
+    assert ray.get(total.remote(ray.put(big))) == float(big.sum())
