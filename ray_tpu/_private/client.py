@@ -118,6 +118,12 @@ class DriverClient(BaseClient):
         except RuntimeError:
             pass  # loop already closed at shutdown
 
+    def incref(self, oid):
+        try:
+            self.loop.call_soon_threadsafe(self.controller.incref, [oid])
+        except RuntimeError:
+            pass
+
     def resources(self):
         return (self._call_soon(lambda: dict(self.controller.total)),
                 self._call_soon(lambda: dict(self.controller.available)))
@@ -303,6 +309,12 @@ class WorkerClient(BaseClient):
     def decref(self, oid):
         try:
             self._send("decref", oids=[oid])
+        except OSError:
+            pass
+
+    def incref(self, oid):
+        try:
+            self._send("incref", oids=[oid])
         except OSError:
             pass
 

@@ -94,6 +94,7 @@ class ActorRecord:
     namespace: str = "default"
     death_reason: str = ""
     env: dict = field(default_factory=dict)
+    resources_claimed: bool = False  # standing allocation held (exactly-once release)
 
 
 @dataclass
@@ -202,7 +203,9 @@ class Controller:
         if w.actor_id:
             # dedicated actor worker: dispatch the pending creation task
             actor = self.actors.get(w.actor_id)
-            if actor and actor.creation_spec is not None:
+            if actor is None or actor.state == A_DEAD:
+                self._kill_worker_proc(w)  # killed before its worker registered
+            elif actor.creation_spec is not None:
                 rec = self.tasks[actor.creation_spec.task_id]
                 self._dispatch(rec, w)
         self._schedule()
@@ -239,6 +242,8 @@ class Controller:
             self._on_unblocked(w, p["task_id"])
         elif kind == "decref":
             self.decref(p["oids"])
+        elif kind == "incref":
+            self.incref(p["oids"])
         elif kind == "next_stream":
             self.loop.create_task(self._worker_next_stream(w, p))
         elif kind == "register_actor_rpc":
@@ -278,8 +283,11 @@ class Controller:
             self._reply(w, p["req_id"], error=e)
 
     async def _worker_wait(self, w, p):
-        ready, not_ready = await self.wait(p["oids"], p["num_returns"], p.get("timeout"))
-        self._reply(w, p["req_id"], ready=ready, not_ready=not_ready)
+        try:
+            ready, not_ready = await self.wait(p["oids"], p["num_returns"], p.get("timeout"))
+            self._reply(w, p["req_id"], ready=ready, not_ready=not_ready)
+        except Exception as e:  # noqa: BLE001 - ship the error to the caller
+            self._reply(w, p["req_id"], error=e)
 
     async def _worker_next_stream(self, w, p):
         try:
@@ -317,6 +325,10 @@ class Controller:
                     self.dep_waiters[v].add(spec.task_id)
         self._validate_feasible(rec)
         if rec.state == FAILED:
+            if spec.is_actor_creation:
+                actor = self.actors.get(spec.actor_id)
+                if actor is not None:
+                    self._fail_actor(actor, "creation infeasible", allow_restart=False)
             return result_oids
         if rec.deps_remaining:
             rec.state = PENDING_DEPS
@@ -457,8 +469,9 @@ class Controller:
         """Actor creation always gets a dedicated worker (ref: raylet leases a
         worker for the actor's lifetime). TPU actors get chip binding env."""
         self._claim(rec.spec.resources, pool)
-        rec.state = "SPAWNING"
         actor = self.actors[rec.spec.actor_id]
+        actor.resources_claimed = True
+        rec.state = "SPAWNING"
         self._assign_tpus(rec, actor)
         self._spawn_worker(actor)
 
@@ -466,6 +479,12 @@ class Controller:
         n = int(rec.spec.resources.get("TPU", 0))
         if n <= 0:
             return
+        if len(self.tpu_free) < n:
+            # accounting says it fits, so this is an internal invariant break —
+            # fail loudly rather than silently under-assigning chips
+            raise RuntimeError(
+                f"TPU accounting mismatch: need {n} chips, free list has "
+                f"{self.tpu_free}")
         assigned, self.tpu_free = self.tpu_free[:n], self.tpu_free[n:]
         rec.spec.runtime_env = dict(rec.spec.runtime_env or {})
         rec.spec.runtime_env["_tpu_ids"] = assigned
@@ -532,25 +551,33 @@ class Controller:
         rec.state = DONE
         rec.done.set()
         if spec.is_actor_creation and actor is not None:
-            actor.state = A_ALIVE
-            actor.worker_id = w.worker_id
+            if actor.state == A_DEAD:
+                # killed while creation was in flight: don't resurrect
+                self._kill_worker_proc(w)
+            else:
+                actor.state = A_ALIVE
+                actor.worker_id = w.worker_id
         self._release_task_resources(rec)
         self._unpin(rec)
         self._schedule()
 
     def _release_task_resources(self, rec: TaskRecord):
-        if rec.spec.actor_id and not rec.spec.is_actor_creation:
-            return  # methods run within the actor's standing allocation
-        pool = self._task_pool(rec.spec)
-        if rec.spec.is_actor_creation:
-            actor = self.actors.get(rec.spec.actor_id)
-            if actor is not None and actor.state == A_DEAD:
-                self._release(rec.spec.resources, pool)
-                tpus = (rec.spec.runtime_env or {}).get("_tpu_ids", [])
-                self.tpu_free.extend(tpus)
-            return  # alive actors keep their allocation
-        self._release(rec.spec.resources, pool)
+        if rec.spec.actor_id:
+            # methods run within the actor's standing allocation; the actor
+            # lifecycle (_fail_actor / _release_actor_allocation) owns the
+            # creation allocation — releasing here would double-free
+            return
+        self._release(rec.spec.resources, self._task_pool(rec.spec))
         tpus = (rec.spec.runtime_env or {}).get("_tpu_ids", [])
+        self.tpu_free.extend(tpus)
+
+    def _release_actor_allocation(self, actor: ActorRecord):
+        """Exactly-once release of an actor's standing resources + chips."""
+        if not actor.resources_claimed or actor.creation_spec is None:
+            return
+        actor.resources_claimed = False
+        self._release(actor.creation_spec.resources, self._task_pool(actor.creation_spec))
+        tpus = (actor.creation_spec.runtime_env or {}).get("_tpu_ids", [])
         self.tpu_free.extend(tpus)
 
     def _unpin(self, rec: TaskRecord):
@@ -664,34 +691,34 @@ class Controller:
         return out
 
     async def wait(self, oids, num_returns, timeout):
+        for oid in oids:
+            if oid not in self.object_events:
+                raise exc.ObjectLostError(oid)
         deadline = None if timeout is None else time.monotonic() + timeout
-        pending = {oid: self.object_events[oid] for oid in oids}
-        ready = []
-        while len(ready) < num_returns:
-            done_now = [oid for oid in oids if oid not in ready and pending[oid].is_set()]
-            for oid in done_now:
-                if oid not in ready:
-                    ready.append(oid)
-                    if len(ready) >= num_returns:
-                        break
-            if len(ready) >= num_returns:
-                break
-            remaining = None if deadline is None else deadline - time.monotonic()
-            if remaining is not None and remaining <= 0:
-                break
-            waiters = [pending[oid].wait() for oid in oids if not pending[oid].is_set()]
-            if not waiters:
-                break
-            try:
-                await asyncio.wait_for(
-                    asyncio.wait([asyncio.ensure_future(x) for x in waiters],
-                                 return_when=asyncio.FIRST_COMPLETED),
-                    remaining)
-            except asyncio.TimeoutError:
-                break
-        ready_in_order = [oid for oid in oids if oid in set(ready)][:num_returns]
-        not_ready = [oid for oid in oids if oid not in set(ready_in_order)]
-        return ready_in_order, not_ready
+        events = {oid: self.object_events[oid] for oid in oids}
+        waiters = {oid: asyncio.ensure_future(ev.wait())
+                   for oid, ev in events.items() if not ev.is_set()}
+        try:
+            while True:
+                n_ready = sum(1 for ev in events.values() if ev.is_set())
+                if n_ready >= num_returns or not waiters:
+                    break
+                remaining = None if deadline is None else deadline - time.monotonic()
+                if remaining is not None and remaining <= 0:
+                    break
+                done, _ = await asyncio.wait(list(waiters.values()),
+                                             timeout=remaining,
+                                             return_when=asyncio.FIRST_COMPLETED)
+                if not done:
+                    break  # timed out
+                for oid in [o for o, f in waiters.items() if f.done()]:
+                    del waiters[oid]
+        finally:
+            for f in waiters.values():
+                f.cancel()
+        ready = [oid for oid in oids if events[oid].is_set()][:num_returns]
+        ready_set = set(ready)
+        return ready, [oid for oid in oids if oid not in ready_set]
 
     def decref(self, oids: List[str]):
         for oid in oids:
@@ -776,6 +803,9 @@ class Controller:
         w = self.workers.get(actor.worker_id)
         if w is not None:
             self._kill_worker_proc(w)
+        for sw in self.spawning.values():  # creation still spawning its worker
+            if sw.actor_id == actor_id:
+                self._kill_worker_proc(sw)
         if no_restart:
             actor.restarts_used = actor.options.max_restarts + 1 if actor.options else 1
         self._fail_actor(actor, "killed via kill()", allow_restart=not no_restart)
@@ -809,12 +839,7 @@ class Controller:
             if rec:
                 self._fail_task(rec, err)
         actor.in_flight.clear()
-        # release the actor's standing resource allocation
-        if actor.creation_spec is not None:
-            pool = self._task_pool(actor.creation_spec)
-            self._release(actor.creation_spec.resources, pool)
-            tpus = (actor.creation_spec.runtime_env or {}).get("_tpu_ids", [])
-            self.tpu_free.extend(tpus)
+        self._release_actor_allocation(actor)
 
     def _on_worker_dead(self, w: WorkerConn, reason: str):
         if w.state == "dead":
@@ -885,7 +910,9 @@ class Controller:
             return
         w.blocked_tasks.add(task_id)
         if not (rec.spec.actor_id and not rec.spec.is_actor_creation):
-            self._release(rec.spec.resources, self._task_pool(rec.spec))
+            # CPU only: TPU chips stay bound to the blocked task (releasing
+            # them would let the scheduler double-book physical chips)
+            self._release(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
         self._schedule()
 
     def _on_unblocked(self, w: WorkerConn, task_id: str):
@@ -896,7 +923,11 @@ class Controller:
         if not (rec.spec.actor_id and not rec.spec.is_actor_creation):
             # may drive available negative: intentional oversubscription, the
             # scheduler simply won't dispatch until it recovers
-            self._claim(rec.spec.resources, self._task_pool(rec.spec))
+            self._claim(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
+
+    @staticmethod
+    def _cpu_only(resources: Dict[str, float]) -> Dict[str, float]:
+        return {k: v for k, v in resources.items() if k != "TPU"}
 
     # --------------------------------------------------------- placement groups
     def create_placement_group(self, bundles: List[Dict[str, float]], strategy: str,

@@ -16,6 +16,18 @@ class ObjectRef:
         self._owned = owned
 
     def __reduce__(self):
+        # Ownership handoff (simplified borrower protocol, ref:
+        # src/ray/core_worker/reference_count.h): serializing a ref bumps the
+        # refcount once; the deserialized copy is owned and decrefs on GC.
+        # Top-level task args never take this path (they're pinned by the
+        # controller for the task's lifetime instead).
+        from . import state
+        client = state.global_client_or_none()
+        if client is not None:
+            try:
+                client.incref(self.id)
+            except Exception:  # noqa: BLE001 - best-effort at teardown
+                pass
         return (_rebuild_ref, (self.id,))
 
     def hex(self) -> str:
@@ -53,7 +65,8 @@ class ObjectRef:
 
 
 def _rebuild_ref(object_id: str):
-    return ObjectRef(object_id, owned=False)
+    # owned: balances the incref done at pickle time
+    return ObjectRef(object_id, owned=True)
 
 
 class ObjectRefGenerator:

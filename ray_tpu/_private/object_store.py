@@ -80,7 +80,15 @@ class StoreClient:
 
     def put_parts(self, object_id: str, meta: bytes, buffers) -> int:
         size = serialization.total_size(meta, buffers)
-        shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True, size=max(size, 1))
+        try:
+            shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True,
+                                             size=max(size, 1))
+        except FileExistsError:
+            # stale segment from a crashed/retried attempt at the same result
+            # oid — replace it (the object is only registered on task_done)
+            self.delete_segment(object_id)
+            shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True,
+                                             size=max(size, 1))
         _unregister(shm)
         mv = shm.buf
         mv[: len(meta)] = meta

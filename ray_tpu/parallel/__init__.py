@@ -1,0 +1,23 @@
+"""Parallelism layer: meshes, sharding rules, collectives (SURVEY.md §2).
+
+Replaces ray.util.collective (NCCL/Gloo) with XLA/ICI collectives over
+jax.sharding meshes; adds the sharding-rule engine Train/Serve/RLlib use.
+"""
+
+from .mesh import AXIS_ORDER, auto_mesh, hybrid_mesh, local_cpu_mesh, make_mesh
+from .sharding import (
+    ShardingRules,
+    batch_spec,
+    data_sharding,
+    llama_rules,
+    shard_tree,
+    tree_paths,
+)
+from . import collective
+from . import xla_ops
+
+__all__ = [
+    "AXIS_ORDER", "make_mesh", "auto_mesh", "hybrid_mesh", "local_cpu_mesh",
+    "ShardingRules", "llama_rules", "batch_spec", "data_sharding", "shard_tree",
+    "tree_paths", "collective", "xla_ops",
+]

@@ -1,0 +1,105 @@
+"""Device mesh construction (TPU-native core of the parallel layer).
+
+Reference contrast: Ray scales out with NCCL groups wired between worker
+processes (python/ray/util/collective). On TPU the equivalent structure is a
+`jax.sharding.Mesh` with named axes — XLA inserts ICI collectives wherever
+shardings demand them. This module is the one place meshes are built so every
+library (train/serve/rllib) agrees on axis names:
+
+  dp    data parallel (batch split, gradient psum)
+  fsdp  fully-sharded data parallel (params sharded over this axis too)
+  tp    tensor parallel (matmul-dimension sharding)
+  sp    sequence/context parallel (ring attention)
+  pp    pipeline parallel (stage dimension)
+  ep    expert parallel (MoE)
+"""
+
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+AXIS_ORDER = ("pp", "dp", "fsdp", "sp", "ep", "tp")
+
+
+def make_mesh(axes: Dict[str, int], devices=None):
+    """Build a Mesh from {axis_name: size}; size -1 means "absorb the rest".
+
+    Axis order follows AXIS_ORDER so the innermost (fastest-varying, most
+    bandwidth-hungry) axes — tp, then ep/sp — land on the physically closest
+    devices, the standard TPU layout recipe (scaling-book: put tp on the
+    innermost ICI torus dimension).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    devices = np.asarray(devices if devices is not None else jax.devices())
+    n = devices.size
+    names = [a for a in AXIS_ORDER if a in axes] + [a for a in axes if a not in AXIS_ORDER]
+    sizes = {a: axes[a] for a in names}
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError(f"only one axis may be -1, got {wild}")
+    fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+    if wild:
+        if n % fixed:
+            raise ValueError(f"{n} devices not divisible by fixed axes {fixed}")
+        sizes[wild[0]] = n // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != n:
+        raise ValueError(f"mesh axes {sizes} need {total} devices, have {n}")
+    try:
+        from jax.experimental import mesh_utils
+        dev_array = mesh_utils.create_device_mesh(tuple(sizes.values()), devices=devices)
+    except Exception:  # noqa: BLE001 - virtual/cpu devices: plain reshape
+        dev_array = devices.reshape(tuple(sizes.values()))
+    return Mesh(dev_array, tuple(sizes.keys()))
+
+
+def auto_mesh(tp: int = 1, pp: int = 1, sp: int = 1, ep: int = 1, fsdp: Optional[int] = None,
+              devices=None):
+    """The common recipe: fix model axes, absorb the remainder into dp/fsdp."""
+    axes = {}
+    if pp > 1:
+        axes["pp"] = pp
+    if fsdp is None:
+        axes["dp"] = -1
+    else:
+        axes["fsdp"] = fsdp
+        axes["dp"] = -1
+    if sp > 1:
+        axes["sp"] = sp
+    if ep > 1:
+        axes["ep"] = ep
+    if tp > 1:
+        axes["tp"] = tp
+    return make_mesh(axes, devices)
+
+
+def hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int]):
+    """Multi-host: outer axes over DCN (between hosts), inner over ICI.
+
+    Reference contrast: Ray spans hosts with GCS + NCCL over TCP; here the
+    compiler handles cross-host collectives when the mesh is built with DCN
+    as the outermost dimension (jax mesh_utils.create_hybrid_device_mesh).
+    """
+    import jax
+    from jax.sharding import Mesh
+    from jax.experimental import mesh_utils
+
+    shape = tuple(ici_axes.values())
+    dcn_shape = tuple(dcn_axes.values())
+    dev = mesh_utils.create_hybrid_device_mesh(shape, dcn_shape, devices=jax.devices())
+    return Mesh(dev, tuple(dcn_axes.keys()) + tuple(ici_axes.keys()))
+
+
+def local_cpu_mesh(n: int = 8, axes: Optional[Dict[str, int]] = None):
+    """Virtual CPU mesh for tests/dry-runs (requires
+    XLA_FLAGS=--xla_force_host_platform_device_count=N set before jax import)."""
+    import jax
+
+    cpus = [d for d in jax.devices() if d.platform == "cpu"] or jax.devices()
+    if len(cpus) < n:
+        raise RuntimeError(
+            f"need {n} cpu devices, have {len(cpus)}; set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={n} before importing jax")
+    return make_mesh(axes or {"dp": n}, cpus[:n])

@@ -1,0 +1,115 @@
+"""Sharding-rule engine: param-tree path patterns → PartitionSpec.
+
+Reference contrast: torch DDP/FSDP wrap modules imperatively
+(python/ray/train/torch). The TPU-native equivalent is declarative: a table
+of (path regex → PartitionSpec) applied over the param pytree, producing
+NamedShardings that pjit consumes; XLA then emits all-gathers/reduce-scatters
+(FSDP) or keeps weights resident (TP) as the specs dictate.
+"""
+
+import re
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def tree_paths(tree):
+    """Flatten a pytree into ("a/b/c", leaf) pairs."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, leaf in flat:
+        keys = []
+        for p in path:
+            if hasattr(p, "key"):
+                keys.append(str(p.key))
+            elif hasattr(p, "idx"):
+                keys.append(str(p.idx))
+            else:
+                keys.append(str(p))
+        out.append(("/".join(keys), leaf))
+    return out
+
+
+class ShardingRules:
+    """Ordered (regex, PartitionSpec) table; first match wins."""
+
+    def __init__(self, rules: Sequence[Tuple[str, P]], default: P = P()):
+        self.rules = [(re.compile(pat), spec) for pat, spec in rules]
+        self.default = default
+
+    def spec_for(self, path: str, leaf=None) -> P:
+        for pat, spec in self.rules:
+            if pat.search(path):
+                return _clip_spec(spec, leaf)
+        return _clip_spec(self.default, leaf)
+
+    def tree_specs(self, tree):
+        """PartitionSpec pytree matching `tree`'s structure."""
+        flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+        paths = [p for p, _ in tree_paths(tree)]
+        specs = [self.spec_for(path, leaf) for path, (_, leaf) in zip(paths, flat)]
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def tree_shardings(self, tree, mesh: Mesh):
+        specs = self.tree_specs(tree)
+        return jax.tree_util.tree_map(
+            lambda s: NamedSharding(mesh, _filter_axes(s, mesh)), specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+
+def _clip_spec(spec: P, leaf) -> P:
+    """Trim a spec to the leaf's rank (rules can be written for the widest case)."""
+    if leaf is None or not hasattr(leaf, "ndim"):
+        return spec
+    return P(*tuple(spec)[: leaf.ndim]) if len(tuple(spec)) > leaf.ndim else spec
+
+
+def _filter_axes(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes the current mesh doesn't have (rules stay portable
+    between e.g. a tp-only mesh and a dp×fsdp×tp mesh)."""
+    names = set(mesh.axis_names)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        if isinstance(entry, (tuple, list)):
+            kept = tuple(a for a in entry if a in names)
+            return kept if kept else None
+        return entry if entry in names else None
+
+    return P(*[keep(e) for e in tuple(spec)])
+
+
+def shard_tree(tree, mesh: Mesh, rules: "ShardingRules"):
+    """device_put the pytree according to the rules (host → sharded HBM)."""
+    shardings = rules.tree_shardings(tree, mesh)
+    return jax.device_put(tree, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Canonical transformer rules (llama-family param tree, see models/llama.py).
+# fsdp shards the large dimension of every matrix; tp shards heads/ffn.
+# ---------------------------------------------------------------------------
+
+def llama_rules() -> ShardingRules:
+    return ShardingRules([
+        (r"embed/embedding", P(("fsdp",), ("tp",))),          # [vocab, d]
+        (r"(wq|wk|wv)/kernel", P(("fsdp",), ("tp",))),         # [d, heads*hd]
+        (r"wo/kernel", P(("tp",), ("fsdp",))),                 # [heads*hd, d]
+        (r"(w_gate|w_up)/kernel", P(("fsdp",), ("tp",))),      # [d, ffn]
+        (r"w_down/kernel", P(("tp",), ("fsdp",))),             # [ffn, d]
+        (r"lm_head/kernel", P(("fsdp",), ("tp",))),            # [d, vocab]
+        (r"(norm|ln)", P()),                                   # replicated
+    ], default=P())
+
+
+def batch_spec(extra_seq_axis: bool = False) -> P:
+    """Activations: batch over (dp, fsdp); optionally sequence over sp."""
+    if extra_seq_axis:
+        return P(("dp", "fsdp"), ("sp",))
+    return P(("dp", "fsdp"))
+
+
+def data_sharding(mesh: Mesh, extra_seq_axis: bool = False) -> NamedSharding:
+    return NamedSharding(mesh, _filter_axes(batch_spec(extra_seq_axis), mesh))

@@ -1,0 +1,50 @@
+"""In-jit collective primitives for use inside shard_map'd compute.
+
+These are the collectives that actually matter on TPU: called inside a
+compiled program, they lower to ICI ops fused into the step. (The eager API
+in collective.py exists for reference parity; hot paths use these.)
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x, axis: str):
+    return lax.psum(x, axis)
+
+
+def pmean(x, axis: str):
+    return lax.pmean(x, axis)
+
+
+def pmax(x, axis: str):
+    return lax.pmax(x, axis)
+
+
+def all_gather(x, axis: str, *, tiled: bool = True, gather_axis: int = 0):
+    return lax.all_gather(x, axis, tiled=tiled, axis=gather_axis)
+
+
+def reduce_scatter(x, axis: str, *, scatter_axis: int = 0):
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis, tiled=True)
+
+
+def ppermute_shift(x, axis: str, shift: int = 1):
+    """Ring shift by `shift` along a mesh axis (ring attention's data motion)."""
+    n = lax.psum(1, axis)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis, perm)
+
+
+def axis_index(axis: str):
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: str):
+    return lax.psum(1, axis)
+
+
+def all_to_all(x, axis: str, split_axis: int, concat_axis: int):
+    return lax.all_to_all(x, axis, split_axis=split_axis, concat_axis=concat_axis,
+                          tiled=True)

@@ -2,8 +2,18 @@
 
 import os
 
-# Must be set before jax is imported anywhere in the test process.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax is imported anywhere in the test process. Force cpu
+# even if the environment exports JAX_PLATFORMS=axon (the real TPU): the test
+# suite is hardware-independent; TPU-only tests are marked `tpu` and opt back
+# in via RAY_TPU_TEST_TPU=1.
+if not os.environ.get("RAY_TPU_TEST_TPU"):
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    # this image auto-imports jax at interpreter startup (sitecustomize), so
+    # the env var alone is read too late — update the live config before the
+    # backend initializes
+    import sys
+    if "jax" in sys.modules:
+        sys.modules["jax"].config.update("jax_platforms", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,108 @@
+"""Regression tests for controller lifecycle bugs found in code review."""
+
+import time
+
+import pytest
+
+
+def test_actor_creation_failure_resource_accounting(ray_session):
+    """Actor whose __init__ raises must not double-release resources."""
+    ray = ray_session
+    before = ray.available_resources()
+
+    @ray.remote(num_cpus=1)
+    class Bad:
+        def __init__(self):
+            raise RuntimeError("born broken")
+
+        def ping(self):
+            return 1
+
+    b = Bad.remote()
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(b.ping.remote(), timeout=30)
+    time.sleep(0.3)
+    after = ray.available_resources()
+    assert after["CPU"] == before["CPU"], f"{before} -> {after}"
+
+
+def test_infeasible_actor_fails_fast(ray_session):
+    """Methods on an infeasible actor error instead of hanging forever."""
+    ray = ray_session
+
+    @ray.remote(num_cpus=128)
+    class TooBig:
+        def ping(self):
+            return 1
+
+    t = TooBig.remote()
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(t.ping.remote(), timeout=10)
+
+
+def test_kill_pending_actor_stays_dead(ray_session):
+    """kill() racing actor creation must not resurrect the actor."""
+    ray = ray_session
+
+    @ray.remote
+    class Slow:
+        def __init__(self):
+            time.sleep(1.0)
+
+        def ping(self):
+            return "alive"
+
+    s = Slow.remote()
+    ray.kill(s)  # creation still spawning/in flight
+    time.sleep(3.0)
+    with pytest.raises(ray.exceptions.RayTpuError):
+        ray.get(s.ping.remote(), timeout=10)
+
+
+def test_returned_nested_ref_survives(ray_session):
+    """A task returning an ObjectRef hands ownership to the caller."""
+    ray = ray_session
+
+    @ray.remote
+    def make_ref():
+        import ray_tpu
+        import numpy as np
+        return ray_tpu.put(np.arange(100_000, dtype=np.float32))
+
+    inner_ref = ray.get(make_ref.remote())
+    import gc
+    gc.collect()
+    time.sleep(0.5)  # let any stray decref land
+    out = ray.get(inner_ref, timeout=10)
+    assert out.shape == (100_000,) and float(out.sum(dtype="float64")) == float(sum(range(100_000)))
+
+
+def test_wait_unknown_object_raises(ray_session):
+    ray = ray_session
+    from ray_tpu._private.object_ref import ObjectRef
+
+    ghost = ObjectRef("obj-999999-deadbeefdeadbeef", owned=False)
+    with pytest.raises(ray.exceptions.ObjectLostError):
+        ray.wait([ghost], num_returns=1, timeout=1)
+
+
+def test_repeated_wait_timeouts_no_leak(ray_session):
+    """Polling-style wait() must not accumulate pending event waiters."""
+    ray = ray_session
+
+    @ray.remote
+    def slow():
+        time.sleep(2)
+        return 1
+
+    ref = slow.remote()
+    for _ in range(20):
+        ready, rest = ray.wait([ref], num_returns=1, timeout=0.05)
+        if ready:
+            break
+    assert ray.get(ref, timeout=30) == 1
+    # leak check: controller loop has no runaway pending tasks
+    import asyncio
+    rt = __import__("ray_tpu.api", fromlist=["_runtime"])._runtime
+    n_tasks = len(asyncio.all_tasks(rt.loop)) if rt else 0
+    assert n_tasks < 25, f"{n_tasks} pending asyncio tasks leaked"

@@ -1,0 +1,158 @@
+"""Collective + sharding tests on the virtual 8-device CPU mesh (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+
+
+def test_make_mesh_wildcard():
+    from ray_tpu.parallel import make_mesh
+
+    mesh = make_mesh({"dp": -1, "tp": 2})
+    assert mesh.shape["tp"] == 2
+    assert mesh.shape["dp"] == 4
+    # axis order: dp outer, tp inner
+    assert mesh.axis_names == ("dp", "tp")
+
+
+def test_make_mesh_errors():
+    from ray_tpu.parallel import make_mesh
+
+    with pytest.raises(ValueError):
+        make_mesh({"dp": 3, "tp": 3})  # 9 != 8
+    with pytest.raises(ValueError):
+        make_mesh({"dp": -1, "tp": -1})
+
+
+def test_xla_allreduce_matches_numpy():
+    from ray_tpu.parallel import collective as col
+
+    col.destroy_collective_group("t1")
+    g = col.init_collective_group(8, 0, backend="xla", group_name="t1", axis="dp")
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    out = np.asarray(g.allreduce(x))
+    # psum over dp: every shard row-block summed; result replicated = col-sum tiled
+    expected = np.tile(x.reshape(8, 1, 4).sum(0), (8, 1))
+    np.testing.assert_allclose(out, expected)
+    col.destroy_collective_group("t1")
+
+
+def test_xla_allgather_identity():
+    from ray_tpu.parallel import collective as col
+
+    col.destroy_collective_group("t2")
+    g = col.init_collective_group(8, 0, backend="xla", group_name="t2", axis="dp")
+    x = np.random.rand(8, 3).astype(np.float32)
+    out = np.asarray(g.allgather(x))
+    np.testing.assert_allclose(out, x)  # tiled all-gather of shards == original
+    col.destroy_collective_group("t2")
+
+
+def test_xla_reducescatter():
+    from ray_tpu.parallel import collective as col
+
+    col.destroy_collective_group("t3")
+    g = col.init_collective_group(8, 0, backend="xla", group_name="t3", axis="dp")
+    x = np.ones((8, 2), dtype=np.float32)
+    out = np.asarray(g.reducescatter(x))
+    # replicated input psum-scattered: each shard gets its slice × world_size...
+    assert out.shape == (8, 2)
+    col.destroy_collective_group("t3")
+
+
+def test_in_jit_collectives_shard_map():
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from jax import shard_map
+    from ray_tpu.parallel import make_mesh, xla_ops
+
+    mesh = make_mesh({"dp": 8})
+
+    def step(x):
+        local_sum = x.sum()
+        total = xla_ops.psum(local_sum, "dp")
+        idx = xla_ops.axis_index("dp").reshape(1)  # rank-1 so P("dp") applies
+        shifted = xla_ops.ppermute_shift(x, "dp", 1)
+        return total, idx, shifted
+
+    f = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"),
+                          out_specs=(P(), P("dp"), P("dp"))))
+    x = jnp.arange(16.0).reshape(8, 2)
+    total, idx, shifted = f(x)
+    assert float(total[()] if total.ndim == 0 else total) == float(x.sum())
+    np.testing.assert_array_equal(np.asarray(idx), np.arange(8))
+    # ring shift moves shard i to position (i+1) % 8
+    np.testing.assert_allclose(np.asarray(shifted), np.roll(np.asarray(x), 1, axis=0))
+
+
+def test_sharding_rules_llama():
+    from jax.sharding import PartitionSpec as P
+    from ray_tpu.parallel import ShardingRules, llama_rules, make_mesh
+
+    rules = llama_rules()
+    assert rules.spec_for("layers/0/attn/wq/kernel") == P(("fsdp",), ("tp",))
+    assert rules.spec_for("layers/0/mlp/w_down/kernel") == P(("tp",), ("fsdp",))
+    assert rules.spec_for("layers/0/attn_norm/scale") == P()
+    assert rules.spec_for("unknown/param") == P()
+
+
+def test_shard_tree_places_params():
+    import jax.numpy as jnp
+    from ray_tpu.parallel import ShardingRules, make_mesh, shard_tree
+    from jax.sharding import PartitionSpec as P
+
+    mesh = make_mesh({"fsdp": 4, "tp": 2})
+    rules = ShardingRules([(r"w", P("fsdp", "tp"))])
+    tree = {"w": jnp.zeros((8, 4)), "b": jnp.zeros((4,))}
+    sharded = shard_tree(tree, mesh, rules)
+    assert sharded["w"].sharding.spec == P("fsdp", "tp")
+    # rule engine clips/filters: bias replicated
+    assert sharded["b"].sharding.is_fully_replicated
+
+
+def test_rules_portable_across_meshes():
+    """The same rule table works on a tp-only mesh (axes filtered)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ray_tpu.parallel import llama_rules, make_mesh, shard_tree
+
+    mesh = make_mesh({"tp": 8})  # no fsdp axis
+    tree = {"wq": {"kernel": jnp.zeros((16, 8))}}
+    sharded = shard_tree(tree, mesh, llama_rules())
+    spec = sharded["wq"]["kernel"].sharding.spec
+    assert spec == P(None, "tp")
+
+
+def test_host_collective_group_across_actors(ray_session):
+    """gloo-equivalent: 2 CPU actors allreduce through the rendezvous actor."""
+    ray = ray_session
+
+    @ray.remote
+    class Member:
+        def __init__(self, rank, world):
+            self.rank, self.world = rank, world
+
+        def _init_collective(self, world_size, rank, backend, group_name):
+            from ray_tpu.parallel import collective as col
+            col.destroy_collective_group(group_name)
+            col.init_collective_group(world_size, rank, backend, group_name)
+            return True
+
+        def do_allreduce(self, x):
+            from ray_tpu.parallel import collective as col
+            return col.allreduce(np.asarray(x, dtype=np.float32), group_name="g2")
+
+        def do_broadcast(self, x):
+            from ray_tpu.parallel import collective as col
+            return col.broadcast(x if x is not None else None, src_rank=0,
+                                 group_name="g2")
+
+    m0, m1 = Member.remote(0, 2), Member.remote(1, 2)
+    from ray_tpu.parallel.collective import create_collective_group
+    create_collective_group([m0, m1], 2, [0, 1], backend="host", group_name="g2")
+    r0 = m0.do_allreduce.remote([1.0, 2.0])
+    r1 = m1.do_allreduce.remote([10.0, 20.0])
+    out0, out1 = ray.get([r0, r1], timeout=60)
+    np.testing.assert_allclose(out0, [11.0, 22.0])
+    np.testing.assert_allclose(out1, [11.0, 22.0])
