@@ -507,7 +507,12 @@ class Controller:
         task_id = p["task_id"]
         rec = self.tasks.get(task_id)
         w.running.discard(task_id)
-        w.blocked_tasks.discard(task_id)
+        if task_id in w.blocked_tasks:
+            # done while marked blocked (no unblocked msg): re-claim the CPU
+            # released at block time so the release below stays balanced
+            w.blocked_tasks.discard(task_id)
+            if rec is not None and not (rec.spec.actor_id and not rec.spec.is_actor_creation):
+                self._claim(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
         if w.actor_id is None and not w.running:
             w.state = "idle"
         if rec is None:
@@ -839,12 +844,26 @@ class Controller:
             if rec:
                 self._fail_task(rec, err)
         actor.in_flight.clear()
+        # A creation still SPAWNING never enters w.running, so no other path
+        # resolves its result oid (e.g. kill() before the worker registered).
+        if actor.creation_spec is not None:
+            crec = self.tasks.get(actor.creation_spec.task_id)
+            if crec is not None and crec.state not in (DONE, FAILED, CANCELLED):
+                self._fail_task(crec, err)
         self._release_actor_allocation(actor)
 
     def _on_worker_dead(self, w: WorkerConn, reason: str):
         if w.state == "dead":
             return
         w.state = "dead"
+        # Undo outstanding blocked-CPU releases first: the failure paths below
+        # release each task's full resources, which would double-release the
+        # CPU that _on_blocked already handed back.
+        for tid in list(w.blocked_tasks):
+            rec = self.tasks.get(tid)
+            if rec is not None and not (rec.spec.actor_id and not rec.spec.is_actor_creation):
+                self._claim(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
+        w.blocked_tasks.clear()
         crash = exc.WorkerCrashedError(reason)
         for tid in list(w.running):
             rec = self.tasks.get(tid)

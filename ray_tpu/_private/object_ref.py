@@ -16,18 +16,13 @@ class ObjectRef:
         self._owned = owned
 
     def __reduce__(self):
-        # Ownership handoff (simplified borrower protocol, ref:
-        # src/ray/core_worker/reference_count.h): serializing a ref bumps the
-        # refcount once; the deserialized copy is owned and decrefs on GC.
-        # Top-level task args never take this path (they're pinned by the
-        # controller for the task's lifetime instead).
-        from . import state
-        client = state.global_client_or_none()
-        if client is not None:
-            try:
-                client.incref(self.id)
-            except Exception:  # noqa: BLE001 - best-effort at teardown
-                pass
+        # Simplified borrower protocol (ref:
+        # src/ray/core_worker/reference_count.h): each DESERIALIZED copy
+        # increfs once (in _rebuild_ref) and decrefs on GC — incref at pickle
+        # time would unbalance whenever the bytes are deserialized 0 or >1
+        # times. The sender must keep its ref alive until the receiver
+        # rebuilds; top-level task args are pinned by the controller for the
+        # task's lifetime, which covers the common path.
         return (_rebuild_ref, (self.id,))
 
     def hex(self) -> str:
@@ -65,8 +60,16 @@ class ObjectRef:
 
 
 def _rebuild_ref(object_id: str):
-    # owned: balances the incref done at pickle time
-    return ObjectRef(object_id, owned=True)
+    from . import state
+    client = state.global_client_or_none()
+    owned = False
+    if client is not None:
+        try:
+            client.incref(object_id)
+            owned = True  # this copy's GC decref balances the incref above
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+    return ObjectRef(object_id, owned=owned)
 
 
 class ObjectRefGenerator:

@@ -1,0 +1,25 @@
+"""Flax model zoo (ray_tpu.models).
+
+Reference contrast: the reference's model code is torch (rllib catalog
+models, serve LLM replicas). Here the flagship is a bf16-first Llama-family
+decoder shaped for the MXU, plus small MLP/CNN torsos for RL policies.
+"""
+
+from ray_tpu.models.llama import (
+    KVCache,
+    Llama,
+    LlamaConfig,
+    llama_compute_flops,
+    llama_param_count,
+)
+from ray_tpu.models.torsos import CNNTorso, MLPTorso
+
+__all__ = [
+    "KVCache",
+    "Llama",
+    "LlamaConfig",
+    "llama_compute_flops",
+    "llama_param_count",
+    "CNNTorso",
+    "MLPTorso",
+]

@@ -1,0 +1,257 @@
+"""Llama-family decoder in flax.linen, TPU-first.
+
+Design points (vs the reference's torch models, e.g. rllib catalog /
+serve LLM replicas):
+- bf16 activations, param dtype configurable (f32 master weights by default;
+  the optimizer state stays f32 — mixed-precision policy lives here, not in a
+  wrapper class like torch AMP).
+- Param-tree paths (`embed/embedding`, `layers_N/attn/wq/kernel`, ...) are the
+  contract with `ray_tpu.parallel.sharding.llama_rules()` — renaming a module
+  changes how it shards.
+- Attention impl is selectable: "flash" (pallas), "xla" (einsum reference),
+  "ring" (sequence-parallel, needs an `sp` mesh axis), or "auto".
+- Decode path uses a static-shape `KVCache` so every step hits the same
+  compiled program.
+- `remat=True` checkpoints each block (jax.checkpoint) — the TPU equivalent
+  of activation checkpointing, trading HBM for recompute.
+"""
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import flax.struct
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import apply_rope, decode_attention, mha_reference
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.ring_attention import ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    head_dim: int = 128
+    ffn_dim: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16       # activations
+    param_dtype: Any = jnp.float32  # master weights
+    attn_impl: str = "auto"         # auto | flash | xla | ring
+    sp_axis: str = "sp"             # mesh axis for ring attention
+    remat: bool = False
+
+    # ---- presets (sizes follow the Llama family; test config is `tiny`) ----
+    @staticmethod
+    def tiny(**kw):
+        return LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+                           n_kv_heads=2, head_dim=16, ffn_dim=128,
+                           max_seq_len=128, rope_theta=10000.0, **kw)
+
+    @staticmethod
+    def llama_125m(**kw):
+        return LlamaConfig(vocab_size=32000, d_model=768, n_layers=12,
+                           n_heads=12, n_kv_heads=12, head_dim=64,
+                           ffn_dim=2048, max_seq_len=2048, **kw)
+
+    @staticmethod
+    def llama_1b(**kw):
+        return LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
+                           n_heads=32, n_kv_heads=8, head_dim=64,
+                           ffn_dim=5632, max_seq_len=4096, **kw)
+
+    @staticmethod
+    def llama_8b(**kw):
+        return LlamaConfig(**kw)  # defaults above are 8B
+
+    @staticmethod
+    def llama_70b(**kw):
+        return LlamaConfig(d_model=8192, n_layers=80, n_heads=64,
+                           n_kv_heads=8, head_dim=128, ffn_dim=28672, **kw)
+
+
+class KVCache(flax.struct.PyTreeNode):
+    """Static-shape per-layer K/V cache: lists of [B, Smax, Kh, D] arrays.
+
+    `length` counts valid tokens per batch row (same for all rows in the
+    simple decode loop; per-row for continuous batching in serve/llm)."""
+    k: Tuple[jax.Array, ...]
+    v: Tuple[jax.Array, ...]
+    length: jax.Array  # [B] int32
+
+    @staticmethod
+    def init(cfg: LlamaConfig, batch: int, max_len: Optional[int] = None,
+             dtype=None):
+        max_len = max_len or cfg.max_seq_len
+        dtype = dtype or cfg.dtype
+        shape = (batch, max_len, cfg.n_kv_heads, cfg.head_dim)
+        zeros = lambda: jnp.zeros(shape, dtype)
+        return KVCache(
+            k=tuple(zeros() for _ in range(cfg.n_layers)),
+            v=tuple(zeros() for _ in range(cfg.n_layers)),
+            length=jnp.zeros((batch,), jnp.int32))
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        xf = x.astype(jnp.float32)
+        normed = xf * jax.lax.rsqrt(jnp.mean(jnp.square(xf), -1, keepdims=True) + self.eps)
+        return (normed * scale).astype(self.dtype)
+
+
+class Attention(nn.Module):
+    cfg: LlamaConfig
+    layer_idx: int = 0
+
+    @nn.compact
+    def __call__(self, x, positions, cache: Optional[KVCache]):
+        cfg = self.cfg
+        layer_idx = self.layer_idx
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        kernel_init=nn.initializers.normal(0.02))
+        b, t, _ = x.shape
+        q = dense(cfg.n_heads * cfg.head_dim, name="wq")(x)
+        k = dense(cfg.n_kv_heads * cfg.head_dim, name="wk")(x)
+        v = dense(cfg.n_kv_heads * cfg.head_dim, name="wv")(x)
+        q = q.reshape(b, t, cfg.n_heads, cfg.head_dim)
+        k = k.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        v = v.reshape(b, t, cfg.n_kv_heads, cfg.head_dim)
+        q = apply_rope(q, positions, cfg.rope_theta)
+        k = apply_rope(k, positions, cfg.rope_theta)
+
+        new_cache_kv = None
+        if cache is not None:
+            # Decode: write current K/V at `length`, attend over the cache.
+            k_cache = jax.vmap(
+                lambda c, u, i: jax.lax.dynamic_update_slice_in_dim(c, u, i, 0)
+            )(cache.k[layer_idx], k, cache.length)
+            v_cache = jax.vmap(
+                lambda c, u, i: jax.lax.dynamic_update_slice_in_dim(c, u, i, 0)
+            )(cache.v[layer_idx], v, cache.length)
+            out = decode_attention(q, k_cache, v_cache, cache.length)
+            new_cache_kv = (k_cache, v_cache)
+        else:
+            impl = cfg.attn_impl
+            if impl == "auto":
+                impl = "flash" if jax.default_backend() == "tpu" else "xla"
+            if impl == "flash":
+                out = flash_attention(q, k, v, causal=True)
+            elif impl == "ring":
+                out = ring_attention(q, k, v, axis_name=cfg.sp_axis, causal=True)
+            else:
+                out = mha_reference(q, k, v, causal=True)
+
+        out = out.reshape(b, t, cfg.n_heads * cfg.head_dim)
+        return dense(cfg.d_model, name="wo")(out), new_cache_kv
+
+
+class MLP(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
+                        param_dtype=cfg.param_dtype,
+                        kernel_init=nn.initializers.normal(0.02))
+        gate = dense(cfg.ffn_dim, name="w_gate")(x)
+        up = dense(cfg.ffn_dim, name="w_up")(x)
+        return dense(cfg.d_model, name="w_down")(nn.silu(gate) * up)
+
+
+class Block(nn.Module):
+    cfg: LlamaConfig
+    layer_idx: int = 0
+
+    @nn.compact
+    def __call__(self, x, positions, cache):
+        cfg = self.cfg
+        h, new_kv = Attention(cfg, self.layer_idx, name="attn")(
+            RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x),
+            positions, cache)
+        x = x + h
+        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(x))
+        return x, new_kv
+
+
+class Llama(nn.Module):
+    cfg: LlamaConfig
+
+    @nn.compact
+    def __call__(self, tokens, positions=None, cache: Optional[KVCache] = None):
+        """tokens [B, T] int32 → logits [B, T, V] (f32), new cache (or None).
+
+        Prefill/train: cache=None, full causal attention. Decode: pass a
+        KVCache; T is the number of new tokens (usually 1)."""
+        cfg = self.cfg
+        b, t = tokens.shape
+        if positions is None:
+            if cache is not None:
+                positions = cache.length[:, None] + jnp.arange(t)[None, :]
+            else:
+                positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+
+        embed = nn.Embed(cfg.vocab_size, cfg.d_model, dtype=cfg.dtype,
+                         param_dtype=cfg.param_dtype,
+                         embedding_init=nn.initializers.normal(0.02),
+                         name="embed")
+        x = embed(tokens)
+
+        block_cls = Block
+        if cfg.remat and cache is None:
+            block_cls = nn.remat(
+                Block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        new_k, new_v = [], []
+        for i in range(cfg.n_layers):
+            x, new_kv = block_cls(cfg, i, name=f"layers_{i}")(x, positions, cache)
+            if new_kv is not None:
+                new_k.append(new_kv[0])
+                new_v.append(new_kv[1])
+
+        x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = nn.Dense(cfg.vocab_size, use_bias=False, dtype=cfg.dtype,
+                              param_dtype=cfg.param_dtype,
+                              kernel_init=nn.initializers.normal(0.02),
+                              name="lm_head")(x)
+        logits = logits.astype(jnp.float32)
+
+        new_cache = None
+        if cache is not None:
+            new_cache = KVCache(k=tuple(new_k), v=tuple(new_v),
+                                length=cache.length + t)
+        return logits, new_cache
+
+
+def llama_param_count(cfg: LlamaConfig) -> int:
+    attn = cfg.d_model * cfg.head_dim * (cfg.n_heads * 2 + cfg.n_kv_heads * 2)
+    mlp = 3 * cfg.d_model * cfg.ffn_dim
+    norms = 2 * cfg.d_model
+    per_layer = attn + mlp + norms
+    embed = cfg.vocab_size * cfg.d_model
+    head = 0 if cfg.tie_embeddings else cfg.vocab_size * cfg.d_model
+    return cfg.n_layers * per_layer + embed + head + cfg.d_model
+
+
+def llama_compute_flops(cfg: LlamaConfig, batch: int, seq: int) -> float:
+    """Training FLOPs per step ≈ 6·N·tokens + attention term (causal)."""
+    n = llama_param_count(cfg) - cfg.vocab_size * cfg.d_model  # exclude embed lookup
+    tokens = batch * seq
+    attn = 6 * cfg.n_layers * cfg.n_heads * cfg.head_dim * batch * seq * seq  # fwd 2 matmuls + bwd, halved for causal
+    return 6.0 * n * tokens + attn

@@ -1,0 +1,45 @@
+"""Small policy/value network torsos for RL (reference: rllib catalog's
+torch MLP/CNN encoders). flax.linen, f32 by default — RL nets are tiny and
+run on whatever device the learner holds."""
+
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLPTorso(nn.Module):
+    hidden_sizes: Sequence[int] = (256, 256)
+    activation: Callable = nn.tanh
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.astype(self.dtype)
+        x = x.reshape(x.shape[0], -1)
+        for i, size in enumerate(self.hidden_sizes):
+            x = nn.Dense(size, dtype=self.dtype, name=f"dense_{i}",
+                         kernel_init=nn.initializers.orthogonal(jnp.sqrt(2)))(x)
+            x = self.activation(x)
+        return x
+
+
+class CNNTorso(nn.Module):
+    """Conv stack for image observations; NHWC (TPU-preferred layout)."""
+    channels: Sequence[int] = (32, 64, 64)
+    kernels: Sequence[Tuple[int, int]] = ((8, 8), (4, 4), (3, 3))
+    strides: Sequence[Tuple[int, int]] = ((4, 4), (2, 2), (1, 1))
+    hidden: int = 512
+    activation: Callable = nn.relu
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if x.dtype == jnp.uint8:  # static dtype check — jit-safe
+            x = x.astype(self.dtype) / 255.0
+        x = x.astype(self.dtype)
+        for i, (ch, k, s) in enumerate(zip(self.channels, self.kernels, self.strides)):
+            x = nn.Conv(ch, k, s, dtype=self.dtype, name=f"conv_{i}")(x)
+            x = self.activation(x)
+        x = x.reshape(x.shape[0], -1)
+        return self.activation(nn.Dense(self.hidden, dtype=self.dtype, name="proj")(x))

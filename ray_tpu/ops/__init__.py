@@ -1,0 +1,27 @@
+"""TPU-native compute ops (ray_tpu.ops).
+
+Reference contrast: the reference's hot ops are CUDA kernels reached through
+torch (rllib models, serve LLM replicas). Here the hot path is pallas TPU
+kernels with XLA fallbacks, so the same code runs on a CPU test mesh
+(interpret mode) and on real chips.
+"""
+
+from ray_tpu.ops.attention import (
+    apply_rope,
+    decode_attention,
+    mha_reference,
+    rope_table,
+)
+from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.ring_attention import ring_attention
+from ray_tpu.ops import losses
+
+__all__ = [
+    "apply_rope",
+    "decode_attention",
+    "mha_reference",
+    "rope_table",
+    "flash_attention",
+    "ring_attention",
+    "losses",
+]

@@ -1,0 +1,122 @@
+"""Reference (XLA) attention, RoPE, and KV-cache decode attention.
+
+These are the non-pallas paths: pure jnp/lax code that XLA fuses well on TPU
+and that runs identically on the CPU test mesh. `flash_attention` (pallas) is
+numerically checked against `mha_reference` in tests.
+
+Reference contrast: the reference reaches attention through torch SDPA /
+flash-attn CUDA kernels (rllib torch models; serve LLM replicas). Here the
+reference path is einsum + f32 softmax, shaped for the MXU: [B, T, H, D]
+activations, GQA via a grouped head axis, bf16 inputs with f32 accumulation.
+"""
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30  # large-but-finite: keeps masked softmax rows NaN-free
+
+
+# ---------------------------------------------------------------------------
+# RoPE
+# ---------------------------------------------------------------------------
+
+def rope_table(max_len: int, head_dim: int, theta: float = 10000.0):
+    """Precompute (sin, cos) tables, each [max_len, head_dim // 2], f32."""
+    freqs = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    angles = jnp.arange(max_len, dtype=jnp.float32)[:, None] * freqs[None, :]
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jax.Array, positions: jax.Array, theta: float = 10000.0):
+    """Rotate-half RoPE. x: [B, T, H, D], positions: [B, T] int32.
+
+    Computed in f32 and cast back to x.dtype (bf16 rotation loses precision
+    at long context).
+    """
+    d = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, d, 2, dtype=jnp.float32) / d))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, T, D/2]
+    sin = jnp.sin(angles)[:, :, None, :]  # [B, T, 1, D/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Dense (XLA) attention with GQA
+# ---------------------------------------------------------------------------
+
+def mha_reference(
+    q: jax.Array,  # [B, Tq, H, D]
+    k: jax.Array,  # [B, Tk, Kh, D] (GQA: H = Kh * groups)
+    v: jax.Array,  # [B, Tk, Kh, D]
+    causal: bool = True,
+    mask: Optional[jax.Array] = None,  # [B, Tq, Tk] or broadcastable, True=keep
+    q_offset: int = 0,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Grouped-query attention, f32 softmax, returns [B, Tq, H, D] in q.dtype.
+
+    `q_offset` shifts query positions for causal masking (decode / chunked
+    prefill: queries start at absolute position q_offset).
+    """
+    b, tq, h, d = q.shape
+    kh = k.shape[2]
+    assert h % kh == 0, f"{h} heads not divisible by {kh} kv heads"
+    g = h // kh
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qg = q.reshape(b, tq, kh, g, d)
+    # [B, Kh, G, Tq, Tk]
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32)
+    s = s * scale
+
+    if causal:
+        tk = k.shape[1]
+        rows = jnp.arange(tq)[:, None] + q_offset
+        cols = jnp.arange(tk)[None, :]
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    if mask is not None:
+        s = jnp.where(mask[:, None, None, :, :] if mask.ndim == 3 else mask, s, NEG_INF)
+
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", p.astype(v.dtype), v)
+    return out.reshape(b, tq, h, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Decode attention over a (pre-allocated) KV cache
+# ---------------------------------------------------------------------------
+
+def decode_attention(
+    q: jax.Array,        # [B, T, H, D] — new-token queries (T=1 decode, T>1 chunked prefill)
+    k_cache: jax.Array,  # [B, Smax, Kh, D] — cache with the new K already written
+    v_cache: jax.Array,  # [B, Smax, Kh, D]
+    lengths: jax.Array,  # [B] int32 — tokens in cache BEFORE this chunk
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Decode/chunked-prefill attention against a static-shape cache.
+
+    Query j sits at absolute position lengths+j and attends cache slots
+    ≤ that position. The whole cache is read and invalid slots masked — on
+    TPU a masked dense read of a static cache beats dynamic-shape gathers,
+    which would force recompilation per step.
+    """
+    b, t, h, d = q.shape
+    smax, kh = k_cache.shape[1], k_cache.shape[2]
+    g = h // kh
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    qg = q.reshape(b, t, kh, g, d)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k_cache, preferred_element_type=jnp.float32)
+    s = s * scale
+    pos = lengths[:, None, None] + jnp.arange(t)[None, :, None]    # [B, T, 1]
+    valid = jnp.arange(smax)[None, None, :] <= pos                 # [B, T, Smax]
+    s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgts,bskd->btkgd", p.astype(v_cache.dtype), v_cache)
+    return out.reshape(b, t, h, d).astype(q.dtype)

@@ -1,0 +1,204 @@
+"""Pallas flash attention (TPU), with a memory-bounded XLA backward.
+
+Forward is a pallas kernel: blocks of Q stream against blocks of K/V held in
+VMEM, online-softmax accumulation in f32 scratch, causal blocks above the
+diagonal skipped entirely (compute scales with the unmasked area). Backward
+recomputes attention per Q-block from the saved logsumexp inside a
+`lax.fori_loop` — flash-style O(T·block) memory without a second kernel (a
+pallas backward is a later-round optimization).
+
+Reference contrast: the reference gets this from flash-attn CUDA via torch.
+On the CPU test mesh the same kernel runs in pallas interpret mode, so
+numerics are tested without hardware (SURVEY.md §4 models/ops).
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128  # TPU lane width: row-stat scratch is kept lane-replicated
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale, causal, block_q, block_kv, num_kv_blocks):
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: blocks strictly above the diagonal contribute nothing.
+    run = (ik * block_kv < (iq + 1) * block_q) if causal else True
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 0)
+            cols = ik * block_kv + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_kv), 1)
+            s = jnp.where(rows >= cols, s, -jnp.inf)
+
+        m_prev = m_scr[:, :1]                                   # [bq, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)               # [bq, 1]
+        m_new = jnp.maximum(m_prev, m_cur)
+        # exp(-inf - -inf) would be NaN on fully-masked rows; they can't occur
+        # under the causal block skip (every kept block has a live diagonal).
+        p = jnp.exp(s - m_new)                                  # [bq, bk]
+        alpha = jnp.exp(m_prev - m_new)                         # [bq, 1]
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == num_kv_blocks - 1)
+    def _finish():
+        l = l_scr[:, :1]
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:, :1] + jnp.log(l)  # [bq, 1]
+
+
+def _flash_fwd(q, k, v, *, causal, scale, block_q, block_kv, interpret):
+    """q: [B, H, T, D]; k, v: [B, Kh, S, D]. Returns (out, lse)."""
+    b, h, tq, d = q.shape
+    kh, tk = k.shape[1], k.shape[2]
+    g = h // kh
+    block_q = min(block_q, tq)
+    block_kv = min(block_kv, tk)
+    nq, nk = tq // block_q, tk // block_kv
+
+    grid = (b, h, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_kv=block_kv, num_kv_blocks=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, iq, ik, g=g: (b_, h_ // g, ik, 0)),
+            pl.BlockSpec((1, 1, block_kv, d), lambda b_, h_, iq, ik, g=g: (b_, h_ // g, ik, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+            # lse rides in [B, H, T, 1]: TPU lowering wants the trailing block
+            # dims (bq, 1) aligned, which a rank-3 (1, 1, bq) block is not
+            pl.BlockSpec((1, 1, block_q, 1), lambda b_, h_, iq, ik: (b_, h_, iq, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, tq, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out, _ = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                        block_q=block_q, block_kv=block_kv, interpret=interpret)
+    return out
+
+
+def _flash_vjp_fwd(q, k, v, causal, scale, block_q, block_kv, interpret):
+    out, lse = _flash_fwd(q, k, v, causal=causal, scale=scale,
+                          block_q=block_q, block_kv=block_kv, interpret=interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_vjp_bwd(causal, scale, block_q, block_kv, interpret, res, do):
+    """Recompute P per Q-block from saved lse; accumulate dk/dv across blocks."""
+    q, k, v, out, lse = res
+    b, h, tq, d = q.shape
+    kh, tk = k.shape[1], k.shape[2]
+    g = h // kh
+    bq = min(block_q, tq)
+    nq = tq // bq
+
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    # delta_i = rowsum(dO_i * O_i)
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,T]
+
+    def body(i, carry):
+        dq, dk, dv = carry
+        sl = i * bq
+        qb = jax.lax.dynamic_slice_in_dim(q, sl, bq, 2).astype(jnp.float32)      # [B,H,bq,D]
+        dob = jax.lax.dynamic_slice_in_dim(do, sl, bq, 2).astype(jnp.float32)
+        lseb = jax.lax.dynamic_slice_in_dim(lse, sl, bq, 2)                      # [B,H,bq]
+        deltab = jax.lax.dynamic_slice_in_dim(delta, sl, bq, 2)
+
+        qg = qb.reshape(b, kh, g, bq, d)
+        s = jnp.einsum("bkgqd,bksd->bkgqs", qg, kf) * scale                      # [B,Kh,G,bq,S]
+        if causal:
+            rows = sl + jnp.arange(bq)[:, None]
+            s = jnp.where(rows >= jnp.arange(tk)[None, :], s, -jnp.inf)
+        p = jnp.exp(s - lseb.reshape(b, kh, g, bq)[..., None])                   # [B,Kh,G,bq,S]
+        dog = dob.reshape(b, kh, g, bq, d)
+        dv = dv + jnp.einsum("bkgqs,bkgqd->bksd", p, dog)
+        dp = jnp.einsum("bkgqd,bksd->bkgqs", dog, vf)
+        ds = p * (dp - deltab.reshape(b, kh, g, bq)[..., None]) * scale
+        dqb = jnp.einsum("bkgqs,bksd->bkgqd", ds, kf).reshape(b, h, bq, d)
+        dk = dk + jnp.einsum("bkgqs,bkgqd->bksd", ds, qg)
+        dq = jax.lax.dynamic_update_slice_in_dim(dq, dqb, sl, 2)
+        return dq, dk, dv
+
+    dq0 = jnp.zeros((b, h, tq, d), jnp.float32)
+    dk0 = jnp.zeros((b, kh, tk, d), jnp.float32)
+    dv0 = jnp.zeros((b, kh, tk, d), jnp.float32)
+    dq, dk, dv = jax.lax.fori_loop(0, nq, body, (dq0, dk0, dv0))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,  # [B, S, Kh, D]
+    v: jax.Array,  # [B, S, Kh, D]
+    causal: bool = True,
+    scale: Optional[float] = None,
+    block_q: int = 256,
+    block_kv: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention in [B, T, H, D] layout (matches `mha_reference`).
+
+    `interpret=None` auto-selects: pallas-compiled on TPU, interpret mode
+    elsewhere. Sequence lengths that don't tile into the (clipped) block
+    sizes fall back to the XLA reference path — the grid would otherwise
+    silently drop the remainder rows.
+    """
+    from ray_tpu.ops.attention import mha_reference
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    tq, tk = q.shape[1], k.shape[1]
+    if tq % min(block_q, tq) or tk % min(block_kv, tk):
+        return mha_reference(q, k, v, causal=causal, scale=scale)
+    scale = scale if scale is not None else 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)  # [B, H, T, D]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out = _flash(qt, kt, vt, causal, scale, block_q, block_kv, interpret)
+    return jnp.swapaxes(out, 1, 2)

@@ -1,0 +1,101 @@
+"""Ring attention: sequence-parallel attention over an ICI ring.
+
+Long-context path (SURVEY.md §2 parallel): Q/K/V are sharded along the
+sequence axis over mesh axis `sp`. Each device keeps its Q shard resident and
+rotates the K/V shards one hop around the ring per step (`lax.ppermute`),
+folding each incoming block into an online-softmax accumulator — the flash
+recurrence at inter-chip scale. Peak memory per device is O(T/P · T/P) per
+step instead of O(T²), and the ppermute rides ICI neighbor links.
+
+Reference contrast: the reference's long-context story is NCCL all-gather of
+KV (ray.util.collective); the ring form never materializes the full sequence
+on any chip.
+
+Call inside shard_map with the sequence axis sharded over `axis_name`:
+
+    mesh = make_mesh({"sp": 4})
+    out = shard_map(
+        lambda q, k, v: ring_attention(q, k, v, axis_name="sp", causal=True),
+        mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp"))(q, k, v)
+"""
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.attention import NEG_INF
+
+
+def _block_attn(q, k, v, scale, row_offset, col_offset, causal):
+    """One flash step: local q [B,Tq,H,D] vs one rotating kv block.
+
+    Returns (m, l, acc) partials in f32: row-max, row-sum, weighted values.
+    """
+    b, tq, h, d = q.shape
+    kh = k.shape[2]
+    g = h // kh
+    qg = q.reshape(b, tq, kh, g, d)
+    s = jnp.einsum("btkgd,bskd->bkgts", qg, k, preferred_element_type=jnp.float32) * scale
+    if causal:
+        rows = row_offset + jnp.arange(tq)[:, None]
+        cols = col_offset + jnp.arange(k.shape[1])[None, :]
+        s = jnp.where(rows >= cols, s, NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B,Kh,G,Tq]
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bkgts,bskd->btkgd", p, v.astype(jnp.float32))
+    return m, l, acc
+
+
+def ring_attention(
+    q: jax.Array,  # [B, T/P, H, D] — local sequence shard
+    k: jax.Array,  # [B, T/P, Kh, D]
+    v: jax.Array,  # [B, T/P, Kh, D]
+    axis_name: str = "sp",
+    causal: bool = True,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Sequence-parallel attention; numerically equals dense attention on the
+    gathered sequence (tested vs `mha_reference` on the CPU mesh)."""
+    b, tq, h, d = q.shape
+    kh = k.shape[2]
+    g = h // kh
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    p_size = jax.lax.psum(1, axis_name)
+    rank = jax.lax.axis_index(axis_name)
+    row_offset = rank * tq
+
+    def step(i, carry):
+        k_cur, v_cur, m_acc, l_acc, out_acc = carry
+        # Block i originated on rank (rank - i) mod P.
+        src = (rank - i) % p_size
+        m_blk, l_blk, acc_blk = _block_attn(
+            q, k_cur, v_cur, scale, row_offset, src * tq, causal)
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        l_new = alpha * l_acc + beta * l_blk
+        out_new = (out_acc * alpha.transpose(0, 3, 1, 2)[..., None]
+                   + acc_blk * beta.transpose(0, 3, 1, 2)[..., None])
+        perm = [(j, (j + 1) % p_size) for j in range(p_size)]
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return k_nxt, v_nxt, m_new, l_new, out_new
+
+    m0 = jnp.full((b, kh, g, tq), -jnp.inf, jnp.float32)
+    l0 = jnp.zeros((b, kh, g, tq), jnp.float32)
+    o0 = jnp.zeros((b, tq, kh, g, d), jnp.float32)
+    # Python loop (p_size is static under shard_map): unrolled ring lets XLA
+    # overlap each ppermute with the next block's compute.
+    carry = (k, v, m0, l0, o0)
+    for i in range(p_size):
+        carry = step(i, carry)
+    _, _, m_f, l_f, out_f = carry
+    # Under causality rank 0's first tokens only ever see themselves; l>0 always.
+    out = out_f / l_f.transpose(0, 3, 1, 2)[..., None]
+    return out.reshape(b, tq, h, d).astype(q.dtype)

@@ -133,11 +133,20 @@ class HostGroup(BaseGroup):
                     name=name, max_concurrency=max(world_size * 4, 8)).remote(world_size)
             except Exception:  # noqa: BLE001 - lost the name race to a peer
                 self.rdv = ray_tpu.get_actor(name)
+        # Collective seq must advance in lockstep on every rank, so p2p
+        # send/recv keeps its own per-pair counters — a rank that only
+        # participates in sends must not desync the collective keys.
         self.seq = 0
+        self._p2p_seq: Dict[tuple, int] = {}
 
     def _key(self, kind):
         self.seq += 1
         return f"{kind}:{self.seq}"
+
+    def _p2p_key(self, src, dst):
+        n = self._p2p_seq.get((src, dst), 0) + 1
+        self._p2p_seq[(src, dst)] = n
+        return (src, dst, n)
 
     def _run(self, kind, data, op=ReduceOp.SUM):
         import ray_tpu
@@ -168,13 +177,13 @@ class HostGroup(BaseGroup):
 
     def send(self, t, dst_rank: int):
         import ray_tpu
-        self.seq += 1
-        ray_tpu.get(self.rdv.send.remote((self.rank, dst_rank, self.seq), np.asarray(t)))
+        key = self._p2p_key(self.rank, dst_rank)
+        ray_tpu.get(self.rdv.send.remote(key, np.asarray(t)))
 
     def recv(self, src_rank: int):
         import ray_tpu
-        self.seq += 1
-        return ray_tpu.get(self.rdv.recv.remote((src_rank, self.rank, self.seq)))
+        key = self._p2p_key(src_rank, self.rank)
+        return ray_tpu.get(self.rdv.recv.remote(key))
 
 
 # ---------------------------------------------------------------------------
@@ -218,18 +227,48 @@ class XlaGroup(BaseGroup):
         return jax.jit(fn)(jnp.asarray(t))
 
     def reducescatter(self, t, op=ReduceOp.SUM):
+        """Axis-0 blocks of `t` are the per-rank tensors (same convention as
+        allreduce); block r of the result is the reduced slice r."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import PartitionSpec as P
         if op != ReduceOp.SUM:
             raise ValueError("reducescatter supports SUM on the xla backend")
         fn = jax.shard_map(lambda x: jax.lax.psum_scatter(x, self.axis, tiled=True),
-                           mesh=self.mesh, in_specs=P(), out_specs=P(self.axis))
+                           mesh=self.mesh, in_specs=P(self.axis), out_specs=P(self.axis))
         return jax.jit(fn)(jnp.asarray(t))
 
     def broadcast(self, t, src_rank=0):
         import jax.numpy as jnp
         return jnp.asarray(t)  # single controller: already globally visible
+
+    def reduce(self, t, dst_rank=0, op=ReduceOp.SUM):
+        # Single controller owns every shard, so "reduce to dst" and
+        # "allreduce" return the same array to the caller.
+        return self.allreduce(t, op)
+
+    def alltoall(self, t):
+        """Block transpose: axis-0 block r holds rank r's W sub-chunks; the
+        result's block r holds chunk r from every rank."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+        fn = jax.shard_map(
+            lambda x: jax.lax.all_to_all(x, self.axis, 0, 0, tiled=True),
+            mesh=self.mesh, in_specs=P(self.axis), out_specs=P(self.axis))
+        return jax.jit(fn)(jnp.asarray(t))
+
+    def send(self, t, dst_rank: int):
+        raise NotImplementedError(
+            "xla backend has no eager send/recv (one controller owns all "
+            "shards) — use ppermute inside shard_map (parallel.xla_ops) or "
+            "backend='host'")
+
+    def recv(self, src_rank: int):
+        raise NotImplementedError(
+            "xla backend has no eager send/recv (one controller owns all "
+            "shards) — use ppermute inside shard_map (parallel.xla_ops) or "
+            "backend='host'")
 
     def barrier(self):
         import jax

@@ -14,21 +14,22 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _path_str(path) -> str:
+    keys = []
+    for p in path:
+        if hasattr(p, "key"):
+            keys.append(str(p.key))
+        elif hasattr(p, "idx"):
+            keys.append(str(p.idx))
+        else:
+            keys.append(str(p))
+    return "/".join(keys)
+
+
 def tree_paths(tree):
     """Flatten a pytree into ("a/b/c", leaf) pairs."""
     flat, _ = jax.tree_util.tree_flatten_with_path(tree)
-    out = []
-    for path, leaf in flat:
-        keys = []
-        for p in path:
-            if hasattr(p, "key"):
-                keys.append(str(p.key))
-            elif hasattr(p, "idx"):
-                keys.append(str(p.idx))
-            else:
-                keys.append(str(p))
-        out.append(("/".join(keys), leaf))
-    return out
+    return [(_path_str(path), leaf) for path, leaf in flat]
 
 
 class ShardingRules:
@@ -47,8 +48,7 @@ class ShardingRules:
     def tree_specs(self, tree):
         """PartitionSpec pytree matching `tree`'s structure."""
         flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
-        paths = [p for p, _ in tree_paths(tree)]
-        specs = [self.spec_for(path, leaf) for path, (_, leaf) in zip(paths, flat)]
+        specs = [self.spec_for(_path_str(path), leaf) for path, leaf in flat]
         return jax.tree_util.tree_unflatten(treedef, specs)
 
     def tree_shardings(self, tree, mesh: Mesh):

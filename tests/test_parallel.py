@@ -54,11 +54,29 @@ def test_xla_reducescatter():
 
     col.destroy_collective_group("t3")
     g = col.init_collective_group(8, 0, backend="xla", group_name="t3", axis="dp")
-    x = np.ones((8, 2), dtype=np.float32)
+    # axis-0 blocks are the per-rank tensors: rank r contributes blocks[r]
+    x = np.random.rand(64).astype(np.float32)
+    blocks = x.reshape(8, 8)
     out = np.asarray(g.reducescatter(x))
-    # replicated input psum-scattered: each shard gets its slice × world_size...
-    assert out.shape == (8, 2)
+    np.testing.assert_allclose(out, blocks.sum(axis=0), rtol=1e-5)
     col.destroy_collective_group("t3")
+
+
+def test_xla_alltoall_and_reduce():
+    from ray_tpu.parallel import collective as col
+
+    col.destroy_collective_group("t4")
+    g = col.init_collective_group(8, 0, backend="xla", group_name="t4", axis="dp")
+    x = np.arange(64, dtype=np.float32)
+    blocks = x.reshape(8, 8)
+    out = np.asarray(g.alltoall(x)).reshape(8, 8)
+    np.testing.assert_allclose(out, blocks.T)  # block transpose
+    red = np.asarray(g.reduce(np.ones(8, np.float32), dst_rank=3))
+    np.testing.assert_allclose(red, np.full(8, 8.0))
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError):
+        g.send(np.ones(2), 1)
+    col.destroy_collective_group("t4")
 
 
 def test_in_jit_collectives_shard_map():
