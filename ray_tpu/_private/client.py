@@ -37,14 +37,14 @@ class BaseClient:
         return out
 
     def _encode_to_store(self, oid, value):
-        """Serialize once; returns (meta_len, size, inline_or_None). Writes
-        shm only when over the inline threshold."""
-        meta, buffers = serialization.dumps_oob(value)
+        """Serialize once; returns (meta_len, size, inline_or_None, contained
+        ref ids). Writes shm only when over the inline threshold."""
+        meta, buffers, contained = serialization.dumps_oob(value)
         size = serialization.total_size(meta, buffers)
         if size <= _INLINE_MAX:
-            return 0, size, serialization.pack_parts(meta, buffers)
+            return 0, size, serialization.pack_parts(meta, buffers), contained
         self.store.put_parts(oid, meta, buffers)
-        return len(meta), size, None
+        return len(meta), size, None, contained
 
     def close(self):
         self.store.close()
@@ -93,8 +93,9 @@ class DriverClient(BaseClient):
 
     def put(self, value):
         oid = ids.object_id()
-        meta_len, size, inline = self._encode_to_store(oid, value)
-        self._call_soon(self.controller.register_put, oid, meta_len, size, inline)
+        meta_len, size, inline, contained = self._encode_to_store(oid, value)
+        self._call_soon(self.controller.register_put, oid, meta_len, size,
+                        inline, contained)
         return oid
 
     def wait(self, oids, num_returns, timeout):
@@ -273,14 +274,15 @@ class WorkerClient(BaseClient):
 
     def put(self, value):
         oid = ids.object_id()
-        meta_len, size, inline = self._encode_to_store(oid, value)
-        self._rpc("put", oid=oid, meta_len=meta_len, size=size, inline=inline)
+        meta_len, size, inline, contained = self._encode_to_store(oid, value)
+        self._rpc("put", oid=oid, meta_len=meta_len, size=size, inline=inline,
+                  contained=contained)
         return oid
 
     def put_result(self, oid, value):
-        """Store a task result; returns (oid, meta_len, size, inline)."""
-        meta_len, size, inline = self._encode_to_store(oid, value)
-        return (oid, meta_len, size, inline)
+        """Store a task result; returns (oid, meta_len, size, inline, contained)."""
+        meta_len, size, inline, contained = self._encode_to_store(oid, value)
+        return (oid, meta_len, size, inline, contained)
 
     def wait(self, oids, num_returns, timeout):
         tid = self.current_task_id

@@ -234,7 +234,8 @@ class Controller:
         elif kind == "wait":
             self.loop.create_task(self._worker_wait(w, p))
         elif kind == "put":
-            self.register_put(p["oid"], p["meta_len"], p["size"], p.get("inline"))
+            self.register_put(p["oid"], p["meta_len"], p["size"], p.get("inline"),
+                              p.get("contained"))
             self._reply(w, p["req_id"], ok=True)
         elif kind == "blocked":
             self._on_blocked(w, p["task_id"])
@@ -323,6 +324,13 @@ class Controller:
                 if meta is None or meta.location == "pending":
                     rec.deps_remaining.add(v)
                     self.dep_waiters[v].add(spec.task_id)
+        # refs buried inside inline arg values: pin (alive) but don't treat as
+        # dispatch deps — the task body fetches them itself if it wants them
+        for v in spec.nested_refs:
+            meta = self.objects.get(v)
+            if meta is not None:
+                meta.pinned += 1
+                rec.pinned.append(v)
         self._validate_feasible(rec)
         if rec.state == FAILED:
             if spec.is_actor_creation:
@@ -511,8 +519,8 @@ class Controller:
             # done while marked blocked (no unblocked msg): re-claim the CPU
             # released at block time so the release below stays balanced
             w.blocked_tasks.discard(task_id)
-            if rec is not None and not (rec.spec.actor_id and not rec.spec.is_actor_creation):
-                self._claim(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
+            if rec is not None:
+                self._reclaim_blocked_cpu(rec)
         if w.actor_id is None and not w.running:
             w.state = "idle"
         if rec is None:
@@ -546,8 +554,8 @@ class Controller:
             self._schedule()
             return
         # success: record result objects
-        for oid, meta_len, size, inline in p["results"]:
-            self.register_put(oid, meta_len, size, inline)
+        for oid, meta_len, size, inline, contained in p["results"]:
+            self.register_put(oid, meta_len, size, inline, contained)
         if spec.num_returns == "streaming":
             st = self.streams.get(task_id)
             if st:
@@ -616,12 +624,19 @@ class Controller:
             self._resolve_dep(oid)
 
     # ------------------------------------------------------------ object table
-    def register_put(self, oid: str, meta_len: int, size: int, inline: Optional[bytes]):
+    def register_put(self, oid: str, meta_len: int, size: int, inline: Optional[bytes],
+                     contained: Optional[List[str]] = None):
         meta = self.objects.get(oid)
         if meta is None:
             meta = ObjectMeta(object_id=oid)
             self.objects[oid] = meta
             self.object_events[oid] = asyncio.Event()
+        if contained:
+            # Containment pinning (ref: reference_count.h nested ids): the
+            # object's bytes hold serialized ObjectRefs; keep those alive for
+            # as long as this object is — released in _evict.
+            meta.contained = list(contained)
+            self.incref(meta.contained)
         meta.meta_len = meta_len
         meta.size = size
         if inline is not None:
@@ -753,10 +768,14 @@ class Controller:
             except OSError:
                 pass
         self.object_events.pop(oid, None)
+        if meta.contained:
+            # the container's bytes are gone; drop its holds on nested objects
+            self.decref(meta.contained)
 
     # ---------------------------------------------------------------- streaming
     def _on_stream_item(self, p: dict):
-        self.register_put(p["oid"], p["meta_len"], p["size"], p.get("inline"))
+        self.register_put(p["oid"], p["meta_len"], p["size"], p.get("inline"),
+                          p.get("contained"))
         st = self.streams.get(p["task_id"])
         if st is not None:
             st.items.append(p["oid"])
@@ -825,8 +844,12 @@ class Controller:
             actor.worker_id = None
             # re-run the creation spec on a fresh dedicated worker
             cspec = actor.creation_spec
-            rec = TaskRecord(spec=cspec, result_oids=self.tasks[cspec.task_id].result_oids,
+            old_rec = self.tasks[cspec.task_id]
+            rec = TaskRecord(spec=cspec, result_oids=old_rec.result_oids,
                              ts_submit=time.time())
+            # carry the arg/nested-ref pins submit() took — the replaced rec
+            # would otherwise leak them (its _unpin never runs)
+            rec.pinned, old_rec.pinned = old_rec.pinned, []
             self.tasks[cspec.task_id] = rec
             self._spawn_worker(actor)
             rec.state = "SPAWNING"
@@ -861,8 +884,8 @@ class Controller:
         # CPU that _on_blocked already handed back.
         for tid in list(w.blocked_tasks):
             rec = self.tasks.get(tid)
-            if rec is not None and not (rec.spec.actor_id and not rec.spec.is_actor_creation):
-                self._claim(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
+            if rec is not None:
+                self._reclaim_blocked_cpu(rec)
         w.blocked_tasks.clear()
         crash = exc.WorkerCrashedError(reason)
         for tid in list(w.running):
@@ -870,6 +893,11 @@ class Controller:
             if rec is None:
                 continue
             spec = rec.spec
+            if spec.is_actor_creation and w.actor_id:
+                # the actor lifecycle below (_fail_actor via w.actor_id) owns
+                # creation retry/failure; re-enqueueing the creation rec here
+                # would race it and double-claim the actor's resources
+                continue
             if spec.actor_id and not spec.is_actor_creation:
                 actor = self.actors.get(spec.actor_id)
                 if actor:
@@ -921,6 +949,17 @@ class Controller:
                 protocol.awrite_msg(w.writer, "cancel_exec", task_id=task_id)
 
     # ------------------------------------------------------------- blocked mgmt
+    def _blocked_cpu_eligible(self, rec: TaskRecord) -> bool:
+        """Actor methods run inside the actor's standing allocation, so
+        block/unblock must not touch the pool for them."""
+        return not (rec.spec.actor_id and not rec.spec.is_actor_creation)
+
+    def _reclaim_blocked_cpu(self, rec: TaskRecord):
+        """Inverse of _on_blocked's release; every path that clears a task
+        from blocked_tasks must call this to keep the pool balanced."""
+        if self._blocked_cpu_eligible(rec):
+            self._claim(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
+
     def _on_blocked(self, w: WorkerConn, task_id: str):
         """Worker blocked in get(): release its cpu so the pool can make
         progress (ref: raylet's NotifyWorkerBlocked / resource borrowing)."""
@@ -928,7 +967,7 @@ class Controller:
         if rec is None or task_id in w.blocked_tasks:
             return
         w.blocked_tasks.add(task_id)
-        if not (rec.spec.actor_id and not rec.spec.is_actor_creation):
+        if self._blocked_cpu_eligible(rec):
             # CPU only: TPU chips stay bound to the blocked task (releasing
             # them would let the scheduler double-book physical chips)
             self._release(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
@@ -939,10 +978,9 @@ class Controller:
         if rec is None or task_id not in w.blocked_tasks:
             return
         w.blocked_tasks.discard(task_id)
-        if not (rec.spec.actor_id and not rec.spec.is_actor_creation):
-            # may drive available negative: intentional oversubscription, the
-            # scheduler simply won't dispatch until it recovers
-            self._claim(self._cpu_only(rec.spec.resources), self._task_pool(rec.spec))
+        # may drive available negative: intentional oversubscription, the
+        # scheduler simply won't dispatch until it recovers
+        self._reclaim_blocked_cpu(rec)
 
     @staticmethod
     def _cpu_only(resources: Dict[str, float]) -> Dict[str, float]:

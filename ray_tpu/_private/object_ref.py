@@ -20,9 +20,12 @@ class ObjectRef:
         # src/ray/core_worker/reference_count.h): each DESERIALIZED copy
         # increfs once (in _rebuild_ref) and decrefs on GC — incref at pickle
         # time would unbalance whenever the bytes are deserialized 0 or >1
-        # times. The sender must keep its ref alive until the receiver
-        # rebuilds; top-level task args are pinned by the controller for the
-        # task's lifetime, which covers the common path.
+        # times. The sender-alive-until-rebuild gap is closed by containment
+        # pinning: serialization records this id (note_contained_ref) and the
+        # runtime pins it on behalf of the containing object/task until that
+        # container is itself evicted/finished.
+        from . import serialization
+        serialization.note_contained_ref(self.id)
         return (_rebuild_ref, (self.id,))
 
     def hex(self) -> str:

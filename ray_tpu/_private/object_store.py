@@ -73,11 +73,8 @@ class StoreClient:
         self._attached = {}  # object_id -> LocalObject (pins shm while in use)
 
     # -- write path ---------------------------------------------------------
-    def put(self, object_id: str, obj) -> int:
-        """Serialize obj into a fresh shm segment. Returns byte size."""
-        meta, buffers = serialization.dumps_oob(obj)
-        return self.put_parts(object_id, meta, buffers)
-
+    # (no whole-object put here: serialization must flow through the clients'
+    # _encode_to_store so contained ObjectRef ids are never dropped)
     def put_parts(self, object_id: str, meta: bytes, buffers) -> int:
         size = serialization.total_size(meta, buffers)
         try:

@@ -15,6 +15,7 @@ on TPU).
 import io
 import pickle
 import struct
+import threading
 
 import cloudpickle
 
@@ -22,9 +23,32 @@ import cloudpickle
 # the copy.
 _OOB_MIN_BYTES = 4096
 
+# Nested-ObjectRef collection (ref: Ray's "contained object IDs",
+# src/ray/core_worker/reference_count.h AddNestedObjectIds). While a
+# serialization is active, ObjectRef.__reduce__ records its id here; the
+# caller pins those ids on behalf of the containing object/task so GC of the
+# sender's ref can't evict an object still reachable through serialized bytes.
+_collector = threading.local()
+
+
+def note_contained_ref(object_id: str) -> None:
+    ids_ = getattr(_collector, "ids", None)
+    if ids_ is not None:
+        ids_.append(object_id)
+
+
+class _CollectRefs:
+    def __enter__(self):
+        self._prev = getattr(_collector, "ids", None)
+        _collector.ids = []
+        return _collector.ids
+
+    def __exit__(self, *a):
+        _collector.ids = self._prev
+
 
 def dumps_oob(obj):
-    """Serialize to (meta_bytes, list_of_buffers).
+    """Serialize to (meta_bytes, list_of_buffers, contained_ref_ids).
 
     meta_bytes layout: u32 npickle | pickle | (u64 size)*nbuf — self-framing so
     a single contiguous shm write round-trips.
@@ -38,17 +62,28 @@ def dumps_oob(obj):
         buffers.append(raw)
         return False
 
-    payload = cloudpickle.dumps(obj, protocol=5, buffer_callback=callback)
+    with _CollectRefs() as contained:
+        payload = cloudpickle.dumps(obj, protocol=5, buffer_callback=callback)
     header = struct.pack("<I", len(payload)) + payload
     for b in buffers:
         header += struct.pack("<Q", b.nbytes)
-    return header, buffers
+    return header, buffers, list(contained)
 
 
-def pack(obj) -> bytes:
-    """Serialize to one contiguous bytes blob (for sockets / small objects)."""
-    meta, buffers = dumps_oob(obj)
-    return pack_parts(meta, buffers)
+def pack_with_refs(obj):
+    """Serialize to one contiguous bytes blob + the nested ObjectRef ids found
+    during serialization. There is deliberately no ref-blind `pack()`:
+    dropping the contained list reopens the sender-GC eviction race."""
+    meta, buffers, contained = dumps_oob(obj)
+    return pack_parts(meta, buffers), contained
+
+
+def dumps_with_refs(obj):
+    """cloudpickle.dumps + contained ObjectRef ids (for function/class blobs
+    that may capture refs in closures or globals)."""
+    with _CollectRefs() as contained:
+        blob = cloudpickle.dumps(obj)
+    return blob, list(contained)
 
 
 def pack_parts(meta: bytes, buffers) -> bytes:

@@ -39,6 +39,9 @@ class TaskSpec:
     # provenance
     parent_task_id: Optional[str] = None
     job_id: Optional[str] = None
+    # ObjectRef ids serialized *inside* inline arg values (not top-level ref
+    # args); the controller pins them for the task's lifetime like ref args
+    nested_refs: List[str] = field(default_factory=list)
 
 
 @dataclass
@@ -68,3 +71,6 @@ class ObjectMeta:
     pinned: int = 0              # in-flight task args pin objects
     error: Optional[Exception] = None
     creating_task: Optional[str] = None
+    # object ids serialized inside this object's bytes; each holds a refcount
+    # until this object is evicted (nested-ref containment)
+    contained: List[str] = field(default_factory=list)

@@ -142,9 +142,10 @@ def _drain_generator(ws, spec, handle_oid, gen):
 
 def _emit(ws, spec, item):
     oid = ids.object_id()
-    _, meta_len, size, inline = ws.client.put_result(oid, item)
+    _, meta_len, size, inline, contained = ws.client.put_result(oid, item)
     ws.client._send("stream_item", task_id=spec.task_id, oid=oid,
-                    meta_len=meta_len, size=size, inline=inline)
+                    meta_len=meta_len, size=size, inline=inline,
+                    contained=contained)
     return oid
 
 

@@ -8,7 +8,7 @@ and can be passed into tasks/other actors.
 
 import cloudpickle
 
-from ._private import ids, state
+from ._private import ids, serialization, state
 from ._private.object_ref import ObjectRef, ObjectRefGenerator
 from ._private.task_spec import ActorCreationOptions, TaskSpec
 from .remote_function import encode_call, _normalize_resources
@@ -62,12 +62,13 @@ class ActorHandle:
 
     def _invoke(self, method_name, args, kwargs, num_returns):
         client = state.global_client()
-        eargs, ekwargs = encode_call(args, kwargs)
+        eargs, ekwargs, nested = encode_call(args, kwargs)
         spec = TaskSpec(
             task_id=ids.task_id(),
             fn_blob=None,
             args=eargs,
             kwargs=ekwargs,
+            nested_refs=nested,
             num_returns=num_returns,
             resources={},
             max_retries=0,
@@ -94,13 +95,31 @@ class ActorClass:
         self._cls = cls
         self._options = options
         self._blob = None
+        self._captured = []  # ref ids in the class blob; held for our lifetime
         self.__name__ = getattr(cls, "__name__", "Actor")
 
     def options(self, **overrides):
         merged = {**self._options, **overrides}
         ac = ActorClass(self._cls, **merged)
         ac._blob = self._blob
+        ac._hold_captured(self._captured)  # its own holds, for its own __del__
         return ac
+
+    def _hold_captured(self, ids_):
+        client = state.global_client_or_none()
+        if client is not None:
+            for oid in ids_:
+                client.incref(oid)
+            self._captured = list(ids_)
+
+    def __del__(self):
+        try:
+            client = state.global_client_or_none()
+            if client is not None:
+                for oid in self._captured:
+                    client.decref(oid)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
     def __call__(self, *a, **k):
         raise TypeError(f"Actor class '{self.__name__}' cannot be instantiated "
@@ -121,7 +140,10 @@ class ActorClass:
         client = state.global_client()
         opts = self._options
         if self._blob is None:
-            self._blob = cloudpickle.dumps(self._cls)
+            # class blobs can capture ObjectRefs in globals/defaults — hold a
+            # refcount for this ActorClass's lifetime (released in __del__)
+            self._blob, captured = serialization.dumps_with_refs(self._cls)
+            self._hold_captured(captured)
         # actors default to holding 0 CPUs while alive (ref: ray defaults —
         # 1 CPU for placement, 0 for running); explicit num_cpus is held.
         res = _normalize_resources({**opts, "num_cpus": opts.get("num_cpus", 0)})
@@ -138,8 +160,9 @@ class ActorClass:
             runtime_env=opts.get("runtime_env"),
             job_id=client.job_id,
         )
-        eargs, ekwargs = encode_call(args, kwargs)
+        eargs, ekwargs, nested = encode_call(args, kwargs)
         creation.args, creation.kwargs = eargs, ekwargs
+        creation.nested_refs = nested
         acopts = ActorCreationOptions(
             max_restarts=opts.get("max_restarts", 0),
             max_task_retries=opts.get("max_task_retries", 0),

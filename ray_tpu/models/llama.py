@@ -82,7 +82,13 @@ class KVCache(flax.struct.PyTreeNode):
     """Static-shape per-layer K/V cache: lists of [B, Smax, Kh, D] arrays.
 
     `length` counts valid tokens per batch row (same for all rows in the
-    simple decode loop; per-row for continuous batching in serve/llm)."""
+    simple decode loop; per-row for continuous batching in serve/llm).
+
+    Capacity invariant (caller-enforced, host-side): length + new_tokens must
+    stay <= Smax. XLA's dynamic_update_slice clamps out-of-range starts, so an
+    overflowing write would silently overwrite the cache tail instead of
+    erroring — drivers (serve/llm, generate loops) must stop or evict at
+    capacity; a data-dependent raise can't live inside jit."""
     k: Tuple[jax.Array, ...]
     v: Tuple[jax.Array, ...]
     length: jax.Array  # [B] int32

@@ -26,14 +26,22 @@ def _normalize_resources(opts) -> dict:
     return {k: v for k, v in res.items() if v}
 
 
-def encode_arg(value):
+def encode_arg(value, nested):
     if isinstance(value, ObjectRef):
         return ("ref", value.id)
-    return ("v", serialization.pack(value))
+    blob, contained = serialization.pack_with_refs(value)
+    nested.extend(contained)
+    return ("v", blob)
 
 
 def encode_call(args, kwargs):
-    return [encode_arg(a) for a in args], {k: encode_arg(v) for k, v in (kwargs or {}).items()}
+    """Returns (args, kwargs, nested_ref_ids) — nested ids are refs buried
+    inside inline values (e.g. f.remote([ref])); the controller pins them for
+    the task's lifetime so caller-side GC can't evict them pre-deserialize."""
+    nested = []
+    eargs = [encode_arg(a, nested) for a in args]
+    ekwargs = {k: encode_arg(v, nested) for k, v in (kwargs or {}).items()}
+    return eargs, ekwargs, nested
 
 
 class RemoteFunction:
@@ -41,13 +49,34 @@ class RemoteFunction:
         self._fn = fn
         self._options = options
         self._blob = None
+        self._captured = []  # ref ids in the fn blob; held for our lifetime
         self.__name__ = getattr(fn, "__name__", "remote_fn")
         self.__doc__ = getattr(fn, "__doc__", None)
 
     def _get_blob(self):
         if self._blob is None:
-            self._blob = cloudpickle.dumps(self._fn)
+            # Refs captured in the closure/globals live only as ids inside the
+            # blob once the driver drops its handles — hold a refcount for the
+            # lifetime of this RemoteFunction (released in __del__).
+            self._blob, captured = serialization.dumps_with_refs(self._fn)
+            self._hold_captured(captured)
         return self._blob
+
+    def _hold_captured(self, ids_):
+        client = state.global_client_or_none()
+        if client is not None:
+            for oid in ids_:
+                client.incref(oid)
+            self._captured = list(ids_)
+
+    def __del__(self):
+        try:
+            client = state.global_client_or_none()
+            if client is not None:
+                for oid in self._captured:
+                    client.decref(oid)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
     def __call__(self, *args, **kwargs):
         raise TypeError(
@@ -58,18 +87,20 @@ class RemoteFunction:
         merged = {**self._options, **overrides}
         rf = RemoteFunction(self._fn, **merged)
         rf._blob = self._blob
+        rf._hold_captured(self._captured)  # its own holds, for its own __del__
         return rf
 
     def remote(self, *args, **kwargs):
         client = state.global_client()
         opts = self._options
         num_returns = opts.get("num_returns", 1)
-        eargs, ekwargs = encode_call(args, kwargs)
+        eargs, ekwargs, nested = encode_call(args, kwargs)
         spec = TaskSpec(
             task_id=ids.task_id(),
             fn_blob=self._get_blob(),
             args=eargs,
             kwargs=ekwargs,
+            nested_refs=nested,
             num_returns=num_returns,
             resources=_normalize_resources(opts),
             max_retries=opts.get("max_retries", 3),

@@ -1,0 +1,20 @@
+"""ray_tpu.train — TPU-native Train library (reference: python/ray/train).
+
+Public surface parity: JaxTrainer, ScalingConfig/RunConfig/CheckpointConfig/
+FailureConfig, Checkpoint, Result, and the in-loop session API
+(report / get_checkpoint / get_context / get_dataset_shard).
+"""
+
+from .checkpoint import Checkpoint
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .ingest import iter_device_batches, prefetch_iterator
+from .session import (TrainContext, TrainingStopped, get_checkpoint,
+                      get_context, get_dataset_shard, report)
+from .trainer import JaxTrainer, Result
+
+__all__ = [
+    "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
+    "ScalingConfig", "JaxTrainer", "Result", "TrainContext",
+    "TrainingStopped", "report", "get_checkpoint", "get_context",
+    "get_dataset_shard", "iter_device_batches", "prefetch_iterator",
+]

@@ -1,0 +1,167 @@
+"""Checkpoint: a directory of saved state (reference:
+python/ray/train/_checkpoint.py `Checkpoint` — an opaque dir + metadata).
+
+TPU re-design: pytrees (params/opt state) are saved with orbax — the
+TPU-native checkpointer that writes sharded arrays without host gather when
+running under a mesh — plus a JSON sidecar for plain metadata. Anything else
+the user puts in the directory rides along untouched.
+"""
+
+import json
+import os
+import pickle
+import shutil
+import tempfile
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+_METADATA_FILE = ".ray_tpu_ckpt_meta.json"
+_PYTREE_DIR = "pytree"
+_PICKLE_FILE = "state.pkl"
+
+
+def _orbax():
+    import orbax.checkpoint as ocp
+    return ocp
+
+
+class Checkpoint:
+    """Handle to a checkpoint directory. Create with `from_directory` (user
+    already wrote files) or `from_state` (we serialize a pytree/dict)."""
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_directory(cls, path: str) -> "Checkpoint":
+        return cls(path)
+
+    @classmethod
+    def from_state(cls, state: Any, path: Optional[str] = None,
+                   metadata: Optional[Dict] = None) -> "Checkpoint":
+        """Serialize `state` into a new checkpoint dir.
+
+        jax pytrees (dicts/lists of arrays) go through orbax; objects orbax
+        can't express fall back to pickle.
+        """
+        path = path or tempfile.mkdtemp(prefix="ray_tpu_ckpt_")
+        os.makedirs(path, exist_ok=True)
+        ckpt = cls(path)
+        try:
+            ocp = _orbax()
+            with ocp.PyTreeCheckpointer() as ckptr:
+                target = os.path.join(path, _PYTREE_DIR)
+                if os.path.exists(target):
+                    shutil.rmtree(target)
+                ckptr.save(target, state)
+        except Exception:  # noqa: BLE001 - non-pytree state → pickle
+            with open(os.path.join(path, _PICKLE_FILE), "wb") as f:
+                pickle.dump(state, f)
+        if metadata:
+            ckpt.set_metadata(metadata)
+        return ckpt
+
+    # -- contents ----------------------------------------------------------
+    def to_state(self, target: Any = None) -> Any:
+        """Inverse of from_state. `target` (a pytree of like-shaped arrays)
+        restores with original dtypes/shardings when given."""
+        pt = os.path.join(self.path, _PYTREE_DIR)
+        if os.path.isdir(pt):
+            ocp = _orbax()
+            with ocp.PyTreeCheckpointer() as ckptr:
+                if target is not None:
+                    try:
+                        return ckptr.restore(pt, item=target)
+                    except TypeError:  # newer orbax: args-based API
+                        return ckptr.restore(pt)
+                return ckptr.restore(pt)
+        pk = os.path.join(self.path, _PICKLE_FILE)
+        if os.path.exists(pk):
+            with open(pk, "rb") as f:
+                return pickle.load(f)
+        raise FileNotFoundError(f"no serialized state in {self.path}")
+
+    def to_directory(self, path: Optional[str] = None) -> str:
+        """Copy checkpoint contents to `path` (reference API parity)."""
+        if path is None:
+            path = tempfile.mkdtemp(prefix="ray_tpu_ckpt_copy_")
+        os.makedirs(path, exist_ok=True)
+        shutil.copytree(self.path, path, dirs_exist_ok=True)
+        return path
+
+    @contextmanager
+    def as_directory(self):
+        """Reference parity: local-dir checkpoints are yielded in place."""
+        yield self.path
+
+    # -- metadata ----------------------------------------------------------
+    def get_metadata(self) -> Dict:
+        p = os.path.join(self.path, _METADATA_FILE)
+        if os.path.exists(p):
+            with open(p) as f:
+                return json.load(f)
+        return {}
+
+    def set_metadata(self, metadata: Dict) -> None:
+        with open(os.path.join(self.path, _METADATA_FILE), "w") as f:
+            json.dump(metadata, f)
+
+    def update_metadata(self, metadata: Dict) -> None:
+        m = self.get_metadata()
+        m.update(metadata)
+        self.set_metadata(m)
+
+    def __repr__(self):
+        return f"Checkpoint(path={self.path!r})"
+
+
+class _CheckpointBook:
+    """Keep-N bookkeeping for an experiment dir (CheckpointConfig policy)."""
+
+    def __init__(self, config):
+        self.config = config
+        self.entries = []  # list of (score, index, Checkpoint)
+        self._index = 0
+
+    def register(self, ckpt: Checkpoint, metrics: Optional[Dict] = None):
+        cfg = self.config
+        score = None
+        if cfg.checkpoint_score_attribute and metrics:
+            score = metrics.get(cfg.checkpoint_score_attribute)
+        self.entries.append((score, self._index, ckpt))
+        self._index += 1
+        if cfg.num_to_keep is not None and len(self.entries) > cfg.num_to_keep:
+            self._evict()
+
+    def _evict(self):
+        cfg = self.config
+        if cfg.checkpoint_score_attribute:
+            sign = 1 if cfg.checkpoint_score_order == "max" else -1
+            # Worst score first; unscored entries evict before scored ones.
+            key = lambda e: (e[0] is not None,
+                             sign * e[0] if e[0] is not None else 0, e[1])
+            victim = min(self.entries, key=key)
+        else:
+            victim = min(self.entries, key=lambda e: e[1])  # oldest
+        self.entries.remove(victim)
+        shutil.rmtree(victim[2].path, ignore_errors=True)
+
+    @property
+    def latest(self) -> Optional[Checkpoint]:
+        if not self.entries:
+            return None
+        return max(self.entries, key=lambda e: e[1])[2]
+
+    @property
+    def best(self) -> Optional[Checkpoint]:
+        cfg = self.config
+        if not self.entries:
+            return None
+        if not cfg.checkpoint_score_attribute:
+            return self.latest
+        sign = 1 if cfg.checkpoint_score_order == "max" else -1
+        scored = [e for e in self.entries if e[0] is not None]
+        if not scored:
+            return self.latest
+        return max(scored, key=lambda e: sign * e[0])[2]

@@ -1,0 +1,88 @@
+"""Train configs (reference: python/ray/train/_internal + ray.train public
+configs — ScalingConfig/RunConfig/CheckpointConfig/FailureConfig in
+python/ray/train/v2/api/config.py, python/ray/air/config.py).
+
+TPU re-design notes: `ScalingConfig.num_workers` in the reference means "how
+many DDP worker processes". Here a *worker* is a host-controller driving all
+its local chips as one SPMD program, so `num_workers` is the DCN (multi-host)
+dimension and `chips_per_worker` the ICI dimension; single-host runs have
+num_workers=1 and all parallelism inside the mesh.
+"""
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+@dataclasses.dataclass
+class ScalingConfig:
+    """How much hardware a trainer uses.
+
+    num_workers: host processes (DCN axis). 1 on a single host.
+    use_tpu: claim TPU chips from the scheduler (`num_tpus` resource).
+    chips_per_worker: chips each worker binds; None = all visible chips.
+    topology: informational slice name ("v5e-8", "v5p-64") used by
+      `ray_tpu.util.tpu` helpers to derive mesh shapes.
+    resources_per_worker: extra custom resources per worker.
+    """
+    num_workers: int = 1
+    use_tpu: bool = False
+    use_gpu: bool = False  # accepted for drop-in compat; TPU build ignores it
+    chips_per_worker: Optional[int] = None
+    topology: Optional[str] = None
+    resources_per_worker: Optional[Dict[str, float]] = None
+    placement_strategy: str = "PACK"
+
+    @property
+    def total_workers(self) -> int:
+        return self.num_workers
+
+    def as_resources(self) -> Dict[str, float]:
+        res = dict(self.resources_per_worker or {})
+        res.setdefault("CPU", 1)
+        if self.use_tpu:
+            res["TPU"] = self.chips_per_worker or 1
+        return res
+
+
+@dataclasses.dataclass
+class CheckpointConfig:
+    """Keep-N policy (reference: ray.train.CheckpointConfig)."""
+    num_to_keep: Optional[int] = None
+    checkpoint_score_attribute: Optional[str] = None
+    checkpoint_score_order: str = "max"  # "max" | "min"
+    checkpoint_frequency: int = 0
+    checkpoint_at_end: bool = False
+
+    def __post_init__(self):
+        if self.checkpoint_score_order not in ("max", "min"):
+            raise ValueError("checkpoint_score_order must be 'max' or 'min'")
+        if self.num_to_keep is not None and self.num_to_keep <= 0:
+            raise ValueError("num_to_keep must be positive or None")
+
+
+@dataclasses.dataclass
+class FailureConfig:
+    """max_failures: retries of the whole train run, resuming from the last
+    checkpoint. 0 disables; -1 = unlimited (reference semantics)."""
+    max_failures: int = 0
+
+
+@dataclasses.dataclass
+class RunConfig:
+    """Where results/checkpoints land (reference: ray.train.RunConfig)."""
+    name: Optional[str] = None
+    storage_path: Optional[str] = None
+    checkpoint_config: CheckpointConfig = dataclasses.field(default_factory=CheckpointConfig)
+    failure_config: FailureConfig = dataclasses.field(default_factory=FailureConfig)
+    stop: Optional[Dict[str, Any]] = None
+    verbose: int = 0
+    log_to_file: bool = False
+
+    def experiment_dir(self) -> str:
+        base = self.storage_path or os.path.join(
+            os.path.expanduser("~"), "ray_tpu_results")
+        name = self.name or "experiment"
+        path = os.path.join(base, name)
+        os.makedirs(path, exist_ok=True)
+        return path

@@ -106,3 +106,72 @@ def test_repeated_wait_timeouts_no_leak(ray_session):
     rt = __import__("ray_tpu.api", fromlist=["_runtime"])._runtime
     n_tasks = len(asyncio.all_tasks(rt.loop)) if rt else 0
     assert n_tasks < 25, f"{n_tasks} pending asyncio tasks leaked"
+
+
+def test_nested_ref_in_put_survives_sender_gc(ray_session):
+    """A ref serialized inside another object must stay alive after the
+    sender's ObjectRef is GC'd (containment pinning)."""
+    import gc
+
+    import numpy as np
+
+    ray = ray_session
+    inner = ray.put(np.arange(100))
+    outer = ray.put({"payload": [inner]})
+    del inner
+    gc.collect()
+    time.sleep(0.3)  # let the decref land at the controller
+    got = ray.get(outer)["payload"][0]
+    np.testing.assert_array_equal(ray.get(got), np.arange(100))
+
+
+def test_ref_returned_from_task(ray_session):
+    """Worker-side put ref returned as a result must survive the worker's
+    frame exit (result-object containment pin)."""
+    ray = ray_session
+
+    @ray.remote
+    def make():
+        return ray.put(123)
+
+    inner_ref = ray.get(make.remote(), timeout=60)
+    time.sleep(0.3)
+    assert ray.get(inner_ref, timeout=60) == 123
+
+
+def test_nested_ref_inside_arg_value(ray_session):
+    """Refs buried in inline arg values are pinned for the task lifetime."""
+    import gc
+
+    ray = ray_session
+
+    @ray.remote
+    def use(lst):
+        return ray.get(lst[0]) + 1
+
+    r = ray.put(41)
+    out = use.remote([r])
+    del r
+    gc.collect()
+    assert ray.get(out, timeout=60) == 42
+
+
+def test_closure_captured_ref_pinned_for_fn_lifetime(ray_session):
+    """A ref captured in a remote fn's globals must stay alive as long as the
+    RemoteFunction does, even after the driver drops its own handle."""
+    import gc
+
+    ray = ray_session
+    g = {}
+    exec("import ray_tpu as ray\n"
+         "r = ray.put(7)\n"
+         "def f():\n"
+         "    return ray.get(r)\n", g)
+    rf = ray.remote(g["f"])
+    out = rf.remote()  # builds the blob → holds the captured ref
+    del g["r"]
+    gc.collect()
+    time.sleep(0.3)
+    assert ray.get(out, timeout=60) == 7
+    # second call after the driver's handle is long gone
+    assert ray.get(rf.remote(), timeout=60) == 7

@@ -1,0 +1,201 @@
+"""Train layer tests (SURVEY.md §4: end-to-end tiny fits, checkpoint/resume,
+failure recovery, keep-N policy)."""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from ray_tpu import train
+from ray_tpu.train import (Checkpoint, CheckpointConfig, FailureConfig,
+                           JaxTrainer, RunConfig, ScalingConfig)
+
+
+def _linreg_loop(config):
+    """Tiny linear-regression fit that reports loss and checkpoints params."""
+    key = jax.random.PRNGKey(0)
+    w = jnp.zeros((3,))
+    x = jax.random.normal(key, (64, 3))
+    y = x @ jnp.array([1.0, -2.0, 0.5])
+
+    start = 0
+    ckpt = train.get_checkpoint()
+    if ckpt is not None:
+        state = ckpt.to_state()
+        w = jnp.asarray(state["w"])
+        start = int(state["step"])
+
+    @jax.jit
+    def step(w):
+        def loss_fn(w):
+            return jnp.mean((x @ w - y) ** 2)
+        loss, g = jax.value_and_grad(loss_fn)(w)
+        return w - 0.1 * g, loss
+
+    for i in range(start, config["steps"]):
+        w, loss = step(w)
+        if config.get("fail_at") is not None and i == config["fail_at"] \
+                and not os.environ.get("_RT_FAILED_ONCE"):
+            os.environ["_RT_FAILED_ONCE"] = "1"
+            raise RuntimeError("injected failure")
+        train.report(
+            {"loss": float(loss), "step": i},
+            checkpoint=Checkpoint.from_state(
+                {"w": np.asarray(w), "step": i + 1}))
+
+
+def test_fit_end_to_end(tmp_path):
+    trainer = JaxTrainer(
+        _linreg_loop,
+        train_loop_config={"steps": 40},
+        scaling_config=ScalingConfig(num_workers=1),
+        run_config=RunConfig(name="linreg", storage_path=str(tmp_path)),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["loss"] < 1e-2
+    assert len(result.metrics_history) == 40
+    assert result.checkpoint is not None
+    state = result.checkpoint.to_state()
+    assert state["step"] == 40
+    np.testing.assert_allclose(
+        np.asarray(state["w"]), [1.0, -2.0, 0.5], atol=0.05)
+
+
+def test_failure_recovery_resumes_from_checkpoint(tmp_path):
+    os.environ.pop("_RT_FAILED_ONCE", None)
+    trainer = JaxTrainer(
+        _linreg_loop,
+        train_loop_config={"steps": 10, "fail_at": 5},
+        run_config=RunConfig(
+            name="failrec", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=1)),
+    )
+    result = trainer.fit()
+    os.environ.pop("_RT_FAILED_ONCE", None)
+    assert result.error is None
+    # Ran 0..4 (failed at 5 before report), resumed from step-5 ckpt, 5..9.
+    steps = [m["step"] for m in result.metrics_history]
+    assert steps[-1] == 9
+    assert result.checkpoint.to_state()["step"] == 10
+
+
+def test_failure_exhausted_returns_error(tmp_path):
+    def always_fail(config):
+        raise ValueError("boom")
+
+    trainer = JaxTrainer(
+        always_fail,
+        run_config=RunConfig(name="fail", storage_path=str(tmp_path),
+                             failure_config=FailureConfig(max_failures=1)),
+    )
+    result = trainer.fit()
+    assert isinstance(result.error, ValueError)
+
+
+def test_keep_n_checkpoints(tmp_path):
+    trainer = JaxTrainer(
+        _linreg_loop,
+        train_loop_config={"steps": 8},
+        run_config=RunConfig(
+            name="keepn", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(num_to_keep=3)),
+    )
+    result = trainer.fit()
+    exp = result.path
+    kept = [d for d in os.listdir(exp) if d.startswith("checkpoint_")]
+    assert len(kept) == 3
+    # Latest survives.
+    assert result.checkpoint.to_state()["step"] == 8
+
+
+def test_keep_best_by_score(tmp_path):
+    def loop(config):
+        for i, score in enumerate([1.0, 5.0, 2.0, 4.0]):
+            train.report({"score": score},
+                         checkpoint=Checkpoint.from_state({"i": i, "s": score}))
+
+    trainer = JaxTrainer(
+        loop,
+        run_config=RunConfig(
+            name="best", storage_path=str(tmp_path),
+            checkpoint_config=CheckpointConfig(
+                num_to_keep=2, checkpoint_score_attribute="score",
+                checkpoint_score_order="max")),
+    )
+    result = trainer.fit()
+    scores = sorted(c.to_state()["s"] for c, _ in result.best_checkpoints)
+    assert scores == [4.0, 5.0]
+
+
+def test_stop_criteria(tmp_path):
+    def loop(config):
+        for i in range(100):
+            train.report({"acc": i / 10.0})
+
+    trainer = JaxTrainer(
+        loop,
+        run_config=RunConfig(name="stop", storage_path=str(tmp_path),
+                             stop={"acc": 0.5}),
+    )
+    result = trainer.fit()
+    assert result.error is None
+    assert result.metrics["acc"] == 0.5
+    assert len(result.metrics_history) == 6  # acc 0.0 .. 0.5
+
+
+def test_session_context_and_datasets(tmp_path):
+    seen = {}
+
+    def loop(config):
+        ctx = train.get_context()
+        seen["world"] = (ctx.get_world_size(), ctx.get_world_rank())
+        seen["data"] = list(train.get_dataset_shard("train"))
+        train.report({"ok": 1})
+
+    JaxTrainer(
+        loop,
+        datasets={"train": [1, 2, 3]},
+        run_config=RunConfig(name="sess", storage_path=str(tmp_path)),
+    ).fit()
+    assert seen["world"] == (1, 0)
+    assert seen["data"] == [1, 2, 3]
+
+
+def test_report_outside_session_raises():
+    with pytest.raises(RuntimeError):
+        train.report({"x": 1})
+
+
+def test_checkpoint_roundtrip_pytree(tmp_path):
+    state = {"params": {"w": np.arange(6.0).reshape(2, 3)},
+             "step": np.asarray(7)}
+    ckpt = Checkpoint.from_state(state, path=str(tmp_path / "ck"))
+    restored = ckpt.to_state()
+    np.testing.assert_array_equal(restored["params"]["w"],
+                                  state["params"]["w"])
+    assert int(np.asarray(restored["step"])) == 7
+    ckpt.set_metadata({"note": "hi"})
+    assert Checkpoint.from_directory(ckpt.path).get_metadata()["note"] == "hi"
+
+
+def test_iter_device_batches_overlap():
+    batches = [{"x": np.full((4,), i, np.float32)} for i in range(5)]
+    out = list(train.iter_device_batches(iter(batches), prefetch=2))
+    assert len(out) == 5
+    assert isinstance(out[0]["x"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(out[3]["x"]), batches[3]["x"])
+
+
+def test_iter_device_batches_with_sharding():
+    from ray_tpu.parallel import local_cpu_mesh
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    mesh = local_cpu_mesh(8, {"dp": 8})
+    sh = NamedSharding(mesh, P("dp"))
+    batches = [np.arange(16, dtype=np.float32) for _ in range(3)]
+    out = list(train.iter_device_batches(iter(batches), sharding=sh))
+    assert out[0].sharding == sh
