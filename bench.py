@@ -1,0 +1,112 @@
+"""Flagship benchmark: Llama train-step throughput (tokens/sec/chip).
+
+Runs fwd+bwd+adamw on a Llama-125M decoder, bf16 activations, on whatever
+backend jax finds (the real TPU chip under the driver; CPU for dev runs).
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (everything
+else goes to stderr). vs_baseline compares against the newest BENCH_r*.json
+the driver recorded, falling back to 1.0 when this is the first measurement
+(the reference fork publishes no numbers — BASELINE.json "published" is {}).
+"""
+
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+
+def _log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _prior_value(repo_dir):
+    best = None
+    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+        m = re.search(r"BENCH_r(\d+)\.json$", path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            val = float(rec.get("value"))
+        except Exception:  # noqa: BLE001 - malformed prior record
+            continue
+        rnd = int(m.group(1))
+        if best is None or rnd > best[0]:
+            best = (rnd, val)
+    return None if best is None else best[1]
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_tpu.models.llama import (Llama, LlamaConfig,
+                                      llama_compute_flops)
+    from ray_tpu.ops.losses import cross_entropy
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+    batch, seq = (8, 2048) if on_tpu else (2, 256)
+    cfg = LlamaConfig.llama_125m(max_seq_len=seq)
+    model = Llama(cfg)
+    _log(f"backend={backend} devices={len(jax.devices())} batch={batch} seq={seq}")
+
+    key = jax.random.PRNGKey(0)
+    tokens = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size)
+    params = model.init(key, tokens[:, :-1])
+    opt = optax.adamw(1e-4)
+    opt_state = opt.init(params)
+
+    def loss_fn(params, tokens):
+        logits, _ = model.apply(params, tokens[:, :-1])
+        loss, _m = cross_entropy(logits, tokens[:, 1:])
+        return loss
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    # warmup / compile. Sync via host fetch (float(loss)), not
+    # block_until_ready: the axon remote backend returns from
+    # block_until_ready before execution finishes, a host fetch can't lie.
+    t0 = time.perf_counter()
+    params, opt_state, loss = train_step(params, opt_state, tokens)
+    float(loss)
+    _log(f"compile+first step: {time.perf_counter() - t0:.1f}s")
+    params, opt_state, loss = train_step(params, opt_state, tokens)
+    float(loss)
+
+    steps = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, loss = train_step(params, opt_state, tokens)
+    final_loss = float(loss)  # chained params deps force all steps to finish
+    dt = time.perf_counter() - t0
+
+    tokens_per_step = batch * seq
+    tps = tokens_per_step * steps / dt
+    n_chips = max(len(jax.devices()), 1)
+    tps_chip = tps / n_chips
+    flops = llama_compute_flops(cfg, batch, seq) * steps / dt
+    _log(f"{tps_chip:,.0f} tokens/s/chip, {flops/1e12:.2f} TFLOP/s "
+         f"({dt/steps*1e3:.1f} ms/step, loss={final_loss:.3f})")
+
+    repo_dir = os.path.dirname(os.path.abspath(__file__))
+    prior = _prior_value(repo_dir)
+    vs = tps_chip / prior if prior else 1.0
+    print(json.dumps({
+        "metric": "llama125m_train_tokens_per_sec_per_chip",
+        "value": round(tps_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -49,24 +49,28 @@ class LlamaConfig:
     sp_axis: str = "sp"             # mesh axis for ring attention
     remat: bool = False
 
-    # ---- presets (sizes follow the Llama family; test config is `tiny`) ----
+    # ---- presets (sizes follow the Llama family; test config is `tiny`).
+    # kwargs override the preset's own values (e.g. tiny(max_seq_len=64)).
     @staticmethod
     def tiny(**kw):
-        return LlamaConfig(vocab_size=256, d_model=64, n_layers=2, n_heads=4,
-                           n_kv_heads=2, head_dim=16, ffn_dim=128,
-                           max_seq_len=128, rope_theta=10000.0, **kw)
+        return LlamaConfig(**{**dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, head_dim=16, ffn_dim=128,
+            max_seq_len=128, rope_theta=10000.0), **kw})
 
     @staticmethod
     def llama_125m(**kw):
-        return LlamaConfig(vocab_size=32000, d_model=768, n_layers=12,
-                           n_heads=12, n_kv_heads=12, head_dim=64,
-                           ffn_dim=2048, max_seq_len=2048, **kw)
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, d_model=768, n_layers=12,
+            n_heads=12, n_kv_heads=12, head_dim=64,
+            ffn_dim=2048, max_seq_len=2048), **kw})
 
     @staticmethod
     def llama_1b(**kw):
-        return LlamaConfig(vocab_size=32000, d_model=2048, n_layers=16,
-                           n_heads=32, n_kv_heads=8, head_dim=64,
-                           ffn_dim=5632, max_seq_len=4096, **kw)
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, d_model=2048, n_layers=16,
+            n_heads=32, n_kv_heads=8, head_dim=64,
+            ffn_dim=5632, max_seq_len=4096), **kw})
 
     @staticmethod
     def llama_8b(**kw):
@@ -74,8 +78,9 @@ class LlamaConfig:
 
     @staticmethod
     def llama_70b(**kw):
-        return LlamaConfig(d_model=8192, n_layers=80, n_heads=64,
-                           n_kv_heads=8, head_dim=128, ffn_dim=28672, **kw)
+        return LlamaConfig(**{**dict(
+            d_model=8192, n_layers=80, n_heads=64,
+            n_kv_heads=8, head_dim=128, ffn_dim=28672), **kw})
 
 
 class KVCache(flax.struct.PyTreeNode):
