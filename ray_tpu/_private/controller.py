@@ -78,6 +78,11 @@ class WorkerConn:
     actor_id: Optional[str] = None  # dedicated actor worker
     blocked_tasks: Set[str] = field(default_factory=set)
     pid: int = 0
+    # TPU-capable workers keep the accelerator runtime env; CPU-only workers
+    # get it stripped at spawn (a process merely *initializing* the TPU
+    # platform library can block on the chip while another process computes,
+    # so plain workers must never touch it)
+    tpu_capable: bool = False
 
 
 @dataclass
@@ -414,7 +419,8 @@ class Controller:
                     self._start_actor_worker(rec, pool)
                     progressing = True
                     continue
-                w = self._find_idle_worker()
+                w = self._find_idle_worker(
+                    need_tpu=rec.spec.resources.get("TPU", 0) > 0)
                 if w is None:
                     self.ready_queue.append(rec)
                     continue
@@ -423,10 +429,16 @@ class Controller:
                 self._dispatch(rec, w)
                 progressing = True
         # spawn workers to match queued demand (never more than cpu slots)
-        demand = sum(1 for rec in self.ready_queue
-                     if rec.state == PENDING and not rec.spec.is_actor_creation
-                     and self._resources_fit(rec.spec.resources, self._task_pool(rec.spec)))
-        self._spawn_for_demand(demand)
+        demand = tpu_demand = 0
+        for rec in self.ready_queue:
+            if (rec.state == PENDING and not rec.spec.is_actor_creation
+                    and self._resources_fit(rec.spec.resources,
+                                            self._task_pool(rec.spec))):
+                if rec.spec.resources.get("TPU", 0) > 0:
+                    tpu_demand += 1
+                else:
+                    demand += 1
+        self._spawn_for_demand(demand, tpu_demand)
         # 2. actor method calls → their dedicated workers
         for actor in self.actors.values():
             if actor.state != A_ALIVE:
@@ -442,16 +454,15 @@ class Controller:
                 actor.in_flight.add(rec.spec.task_id)
                 self._dispatch(rec, w)
 
-    def _find_idle_worker(self) -> Optional[WorkerConn]:
+    def _find_idle_worker(self, need_tpu: bool = False) -> Optional[WorkerConn]:
         for w in self.workers.values():
-            if w.state == "idle" and w.actor_id is None:
+            if w.state == "idle" and w.actor_id is None and w.tpu_capable == need_tpu:
                 return w
         return None
 
-    def _spawn_for_demand(self, demand: int):
-        if demand <= 0:
-            return
-        spawning = sum(1 for w in self.spawning.values() if w.actor_id is None)
+    def _spawn_for_demand(self, demand: int, tpu_demand: int = 0):
+        spawning = sum(1 for w in self.spawning.values()
+                       if w.actor_id is None and not w.tpu_capable)
         n_alive = sum(1 for w in list(self.workers.values()) + list(self.spawning.values())
                       if w.actor_id is None and w.state != "dead")
         n_blocked = sum(1 for w in self.workers.values()
@@ -459,17 +470,39 @@ class Controller:
         headroom = self.max_workers - (n_alive - n_blocked)
         for _ in range(max(0, min(demand - spawning, headroom))):
             self._spawn_worker()
+        # TPU pool-workers: one persistent worker serves the chip queue (a
+        # second process can't initialize the platform while the first
+        # computes, so more would just block at startup)
+        if tpu_demand > 0:
+            have = sum(1 for w in list(self.workers.values()) + list(self.spawning.values())
+                       if w.actor_id is None and w.tpu_capable and w.state != "dead")
+            if have == 0:
+                self._spawn_worker(tpu_capable=True)
 
-    def _spawn_worker(self, actor: ActorRecord = None) -> WorkerConn:
+    # env vars that bind a process to the accelerator runtime; stripped for
+    # CPU-only workers (see WorkerConn.tpu_capable)
+    _TPU_ENV_KEYS = ("PALLAS_AXON_POOL_IPS", "TPU_WORKER_HOSTNAMES",
+                     "PALLAS_AXON_TPU_GEN")
+
+    def _spawn_worker(self, actor: ActorRecord = None,
+                      tpu_capable: bool = False) -> WorkerConn:
         wid = ids.worker_id()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = wid
         if actor is not None:
+            tpu_capable = (actor.creation_spec is not None and
+                           actor.creation_spec.resources.get("TPU", 0) > 0)
             env.update({k: str(v) for k, v in (actor.env or {}).items()})
+        if not tpu_capable:
+            for k in self._TPU_ENV_KEYS:
+                env.pop(k, None)
+            env["JAX_PLATFORMS"] = "cpu"
         proc = subprocess.Popen(
             [sys.executable, "-m", "ray_tpu._private.worker_main", self.socket_path, wid],
             env=env, stdin=subprocess.DEVNULL)
-        w = WorkerConn(worker_id=wid, proc=proc, actor_id=actor.actor_id if actor else None)
+        w = WorkerConn(worker_id=wid, proc=proc,
+                       actor_id=actor.actor_id if actor else None,
+                       tpu_capable=tpu_capable)
         self.spawning[wid] = w
         return w
 

@@ -1,0 +1,18 @@
+"""ray_tpu.rllib — TPU-native RL library (reference: rllib/).
+
+Stack: AlgorithmConfig/Algorithm drive iterations; EnvRunners collect CPU
+rollouts (inline or as ray_tpu actors); a jax Learner runs the whole SGD
+update as one jitted program on the TPU.
+"""
+
+from .algorithm import Algorithm, AlgorithmConfig
+from .algorithms.ppo import PPO, PPOConfig
+from .env_runner import EnvRunner
+from .learner import JaxLearner, LearnerGroup
+from .rl_module import ModuleSpec, RLModule
+from .sample_batch import SampleBatch
+
+__all__ = [
+    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "EnvRunner",
+    "JaxLearner", "LearnerGroup", "ModuleSpec", "RLModule", "SampleBatch",
+]

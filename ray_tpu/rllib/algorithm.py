@@ -1,0 +1,268 @@
+"""AlgorithmConfig + Algorithm base (reference:
+rllib/algorithms/algorithm_config.py, rllib/algorithms/algorithm.py).
+
+Same builder API (`config.environment(...).training(...).env_runners(...)`)
+and `algo.train()` iteration loop. Execution differs TPU-first: the learner's
+update is one jitted program on the chip; env runners are CPU processes —
+inline objects for `num_env_runners=0`, ray_tpu actors otherwise.
+"""
+
+import copy
+import time
+from typing import Any, Callable, Dict, List, Optional, Type, Union
+
+import numpy as np
+
+from ray_tpu.train.checkpoint import Checkpoint
+from .env_runner import EnvRunner
+from .rl_module import ModuleSpec
+from .sample_batch import SampleBatch
+
+
+class AlgorithmConfig:
+    algo_class: Optional[Type["Algorithm"]] = None
+
+    def __init__(self):
+        # environment
+        self.env: Union[str, Callable, None] = None
+        self.env_config: Dict = {}
+        # env runners
+        self.num_env_runners = 0
+        self.num_envs_per_env_runner = 1
+        self.rollout_fragment_length = 200
+        self.explore = True
+        # training
+        self.lr = 3e-4
+        self.gamma = 0.99
+        self.train_batch_size = 4000
+        self.minibatch_size = 128
+        self.num_epochs = 1
+        self.grad_clip: Optional[float] = None
+        self.model: Dict = {"hiddens": (256, 256)}
+        # learners
+        self.num_learners = 0
+        self.num_tpus_per_learner = 0
+        # evaluation
+        self.evaluation_interval = 0
+        self.evaluation_duration = 5
+        # misc
+        self.seed = 0
+        self.framework_str = "jax"
+
+    # -- builder sections (each returns self, reference-style) ---------------
+    def environment(self, env=None, *, env_config=None, **_):
+        if env is not None:
+            self.env = env
+        if env_config is not None:
+            self.env_config = dict(env_config)
+        return self
+
+    def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None,
+                    rollout_fragment_length=None, explore=None, **_):
+        if num_env_runners is not None:
+            self.num_env_runners = num_env_runners
+        if num_envs_per_env_runner is not None:
+            self.num_envs_per_env_runner = num_envs_per_env_runner
+        if rollout_fragment_length is not None:
+            self.rollout_fragment_length = rollout_fragment_length
+        if explore is not None:
+            self.explore = explore
+        return self
+
+    def training(self, *, lr=None, gamma=None, train_batch_size=None,
+                 minibatch_size=None, num_epochs=None, grad_clip=None,
+                 model=None, **kwargs):
+        if lr is not None:
+            self.lr = lr
+        if gamma is not None:
+            self.gamma = gamma
+        if train_batch_size is not None:
+            self.train_batch_size = train_batch_size
+        if minibatch_size is not None:
+            self.minibatch_size = minibatch_size
+        if num_epochs is not None:
+            self.num_epochs = num_epochs
+        if grad_clip is not None:
+            self.grad_clip = grad_clip
+        if model is not None:
+            self.model.update(model)
+        for k, v in kwargs.items():  # algorithm-specific keys land as attrs
+            setattr(self, k, v)
+        return self
+
+    def learners(self, *, num_learners=None, num_tpus_per_learner=None, **_):
+        if num_learners is not None:
+            self.num_learners = num_learners
+        if num_tpus_per_learner is not None:
+            self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def evaluation(self, *, evaluation_interval=None, evaluation_duration=None, **_):
+        if evaluation_interval is not None:
+            self.evaluation_interval = evaluation_interval
+        if evaluation_duration is not None:
+            self.evaluation_duration = evaluation_duration
+        return self
+
+    def framework(self, framework: str = "jax", **_):
+        if framework not in ("jax", "tf2", "torch"):
+            raise ValueError(framework)
+        self.framework_str = framework
+        return self
+
+    def resources(self, **_):
+        return self
+
+    def debugging(self, *, seed=None, **_):
+        if seed is not None:
+            self.seed = seed
+        return self
+
+    def copy(self) -> "AlgorithmConfig":
+        return copy.deepcopy(self)
+
+    def build(self, env=None) -> "Algorithm":
+        if env is not None:
+            self.env = env
+        if self.algo_class is None:
+            raise ValueError("config has no algo_class; use a subclass "
+                             "like PPOConfig")
+        return self.algo_class(self.copy())
+
+    # alias matching the reference's newer naming
+    build_algo = build
+
+
+class Algorithm:
+    """Iteration driver: `train()` = collect → learn → metrics."""
+
+    def __init__(self, config: AlgorithmConfig):
+        self.config = config
+        self.iteration = 0
+        self._timers: Dict[str, float] = {}
+        self._runner_handles: List = []
+        self._local_runner: Optional[EnvRunner] = None
+        self.setup(config)
+
+    # -- runner fleet --------------------------------------------------------
+    def _make_runner_kwargs(self) -> Dict[str, Any]:
+        cfg = self.config
+        return dict(
+            env_creator=cfg.env,
+            num_envs=cfg.num_envs_per_env_runner,
+            rollout_len=cfg.rollout_fragment_length,
+            explore=cfg.explore,
+            seed=cfg.seed,
+            gamma=cfg.gamma,
+        )
+
+    def _setup_runners(self):
+        cfg = self.config
+        if cfg.num_env_runners <= 0:
+            self._local_runner = EnvRunner(**self._make_runner_kwargs())
+            return
+        import ray_tpu
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        RemoteRunner = ray_tpu.remote(num_cpus=1)(EnvRunner)
+        self._runner_handles = [
+            RemoteRunner.remote(**{**self._make_runner_kwargs(),
+                                   "seed": cfg.seed + i})
+            for i in range(cfg.num_env_runners)]
+        # a local runner only to derive the module spec (no sampling)
+        self._local_runner = EnvRunner(**{**self._make_runner_kwargs(),
+                                          "num_envs": 1, "rollout_len": 2})
+
+    def _sample_all(self, weights) -> (SampleBatch, Dict):
+        import ray_tpu
+        if self._runner_handles:
+            wref = ray_tpu.put(weights)
+            batches = ray_tpu.get(
+                [r.sample.remote(wref) for r in self._runner_handles])
+            metrics = ray_tpu.get(
+                [r.pop_metrics.remote() for r in self._runner_handles])
+            return SampleBatch.concat(batches), _merge_runner_metrics(metrics)
+        b = self._local_runner.sample(weights)
+        return b, self._local_runner.pop_metrics()
+
+    # -- to implement --------------------------------------------------------
+    def setup(self, config: AlgorithmConfig):
+        raise NotImplementedError
+
+    def training_step(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    # -- public api ----------------------------------------------------------
+    def train(self) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        result = self.training_step()
+        self.iteration += 1
+        result.setdefault("training_iteration", self.iteration)
+        result["time_this_iter_s"] = time.perf_counter() - t0
+        if (self.config.evaluation_interval and
+                self.iteration % self.config.evaluation_interval == 0):
+            result["evaluation"] = self.evaluate()
+        return result
+
+    def evaluate(self) -> Dict[str, Any]:
+        """Greedy-policy episodes on a fresh env (reference: evaluation
+        workers; single inline runner here)."""
+        cfg = self.config
+        runner = EnvRunner(env_creator=cfg.env, num_envs=1,
+                           rollout_len=cfg.rollout_fragment_length,
+                           explore=False, seed=cfg.seed + 10_000)
+        try:
+            runner.set_weights(self.get_weights())
+            while runner.num_completed_episodes() < cfg.evaluation_duration:
+                runner.sample()
+            return runner.pop_metrics()
+        finally:
+            runner.close()
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, weights):
+        raise NotImplementedError
+
+    def get_state(self) -> Dict:
+        return {"weights": self.get_weights(), "iteration": self.iteration,
+                "config_class": type(self.config).__name__}
+
+    def set_state(self, state: Dict):
+        self.set_weights(state["weights"])
+        self.iteration = state.get("iteration", 0)
+
+    def save(self, path: Optional[str] = None) -> Checkpoint:
+        return Checkpoint.from_state(self.get_state(), path=path)
+
+    def restore(self, ckpt: Union[str, Checkpoint]):
+        if isinstance(ckpt, str):
+            ckpt = Checkpoint.from_directory(ckpt)
+        self.set_state(ckpt.to_state())
+
+    def stop(self):
+        if self._local_runner:
+            self._local_runner.close()
+        for h in self._runner_handles:
+            try:
+                import ray_tpu
+                ray_tpu.kill(h)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+
+
+def _merge_runner_metrics(ms: List[Dict]) -> Dict:
+    out: Dict[str, Any] = {"episodes_this_iter": sum(
+        m.get("episodes_this_iter", 0) for m in ms)}
+    means = [m for m in ms if "episode_return_mean" in m]
+    if means:
+        out["episode_return_mean"] = float(np.mean(
+            [m["episode_return_mean"] for m in means]))
+        out["episode_return_max"] = float(np.max(
+            [m["episode_return_max"] for m in means]))
+        out["episode_return_min"] = float(np.min(
+            [m["episode_return_min"] for m in means]))
+        out["episode_len_mean"] = float(np.mean(
+            [m["episode_len_mean"] for m in means]))
+    return out

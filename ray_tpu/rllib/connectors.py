@@ -1,0 +1,75 @@
+"""Connectors — batch post/pre-processing between env runners and learner
+(reference: rllib/connectors; GAE in rllib/evaluation/postprocessing.py).
+
+The advantage math runs as a jitted scan over the time axis (ops.losses.gae
+handles [T] and [T, B]) instead of the reference's per-episode python loops —
+rollout batches keep static [T, B] shapes so nothing recompiles.
+"""
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops.losses import gae as _gae
+from . import sample_batch as SB
+from .sample_batch import SampleBatch
+
+
+@jax.jit
+def _gae_jit(rewards, values_tb1, dones, gamma, lam):
+    return _gae(rewards, values_tb1, dones, gamma, lam)
+
+
+def compute_gae(batch: SampleBatch, gamma: float = 0.99,
+                lam: float = 0.95) -> SampleBatch:
+    """Add ADVANTAGES and VALUE_TARGETS to a [T, B] rollout batch.
+
+    Needs VF_PREDS [T, B], BOOTSTRAP_VALUE [B] (value of the obs after the
+    last step, zeroed where terminated), DONES [T, B].
+    """
+    rewards = jnp.asarray(batch[SB.REWARDS], jnp.float32)
+    vf = jnp.asarray(batch[SB.VF_PREDS], jnp.float32)
+    boot = jnp.asarray(batch[SB.BOOTSTRAP_VALUE], jnp.float32)
+    dones = jnp.asarray(batch[SB.DONES], jnp.float32)
+    values = jnp.concatenate([vf, boot[None]], axis=0)  # [T+1, B]
+    adv, targets = _gae_jit(rewards, values, dones, gamma, lam)
+    batch[SB.ADVANTAGES] = np.asarray(adv)
+    batch[SB.VALUE_TARGETS] = np.asarray(targets)
+    return batch
+
+
+def standardize_advantages(batch: SampleBatch) -> SampleBatch:
+    adv = np.asarray(batch[SB.ADVANTAGES])
+    batch[SB.ADVANTAGES] = (adv - adv.mean()) / (adv.std() + 1e-8)
+    return batch
+
+
+class RunningMeanStd:
+    """Streaming obs normalizer (reference: rllib MeanStdFilter)."""
+
+    def __init__(self, shape):
+        self.mean = np.zeros(shape, np.float64)
+        self.var = np.ones(shape, np.float64)
+        self.count = 1e-4
+
+    def update(self, x: np.ndarray):
+        x = x.reshape((-1,) + self.mean.shape)
+        b_mean, b_var, b_count = x.mean(0), x.var(0), x.shape[0]
+        delta = b_mean - self.mean
+        tot = self.count + b_count
+        self.mean = self.mean + delta * b_count / tot
+        m_a = self.var * self.count
+        m_b = b_var * b_count
+        self.var = (m_a + m_b + np.square(delta) * self.count * b_count / tot) / tot
+        self.count = tot
+
+    def normalize(self, x: np.ndarray) -> np.ndarray:
+        return ((x - self.mean) / np.sqrt(self.var + 1e-8)).astype(np.float32)
+
+    def state(self):
+        return {"mean": self.mean, "var": self.var, "count": self.count}
+
+    def set_state(self, s):
+        self.mean, self.var, self.count = s["mean"], s["var"], s["count"]

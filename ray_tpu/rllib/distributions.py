@@ -1,0 +1,89 @@
+"""Action distributions (reference: rllib/models/torch/torch_distributions.py).
+
+Pure-jnp, usable inside jit on TPU and on the CPU inference path in
+EnvRunners. Each distribution is a thin struct over its parameters; methods
+are vectorized over leading batch dims.
+"""
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Categorical:
+    def __init__(self, logits: jax.Array):
+        self.logits = logits  # [..., n]
+
+    def sample(self, key) -> jax.Array:
+        return jax.random.categorical(key, self.logits, axis=-1)
+
+    def mode(self) -> jax.Array:
+        return jnp.argmax(self.logits, axis=-1)
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return jnp.take_along_axis(logp, x[..., None].astype(jnp.int32), -1)[..., 0]
+
+    def entropy(self) -> jax.Array:
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def kl(self, other: "Categorical") -> jax.Array:
+        logp = jax.nn.log_softmax(self.logits, axis=-1)
+        logq = jax.nn.log_softmax(other.logits, axis=-1)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+
+class DiagGaussian:
+    def __init__(self, mean: jax.Array, log_std: jax.Array):
+        self.mean = mean
+        self.log_std = jnp.broadcast_to(log_std, mean.shape)
+
+    def sample(self, key) -> jax.Array:
+        return self.mean + jnp.exp(self.log_std) * jax.random.normal(
+            key, self.mean.shape, self.mean.dtype)
+
+    def mode(self) -> jax.Array:
+        return self.mean
+
+    def log_prob(self, x: jax.Array) -> jax.Array:
+        var = jnp.exp(2 * self.log_std)
+        ll = -0.5 * (jnp.square(x - self.mean) / var
+                     + 2 * self.log_std + jnp.log(2 * jnp.pi))
+        return jnp.sum(ll, axis=-1)
+
+    def entropy(self) -> jax.Array:
+        return jnp.sum(self.log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), axis=-1)
+
+    def kl(self, other: "DiagGaussian") -> jax.Array:
+        var, ovar = jnp.exp(2 * self.log_std), jnp.exp(2 * other.log_std)
+        return jnp.sum(other.log_std - self.log_std
+                       + (var + jnp.square(self.mean - other.mean)) / (2 * ovar)
+                       - 0.5, axis=-1)
+
+
+class SquashedGaussian:
+    """tanh-squashed gaussian for SAC (bounded continuous actions)."""
+
+    def __init__(self, mean: jax.Array, log_std: jax.Array,
+                 low: float = -1.0, high: float = 1.0):
+        self.base = DiagGaussian(mean, jnp.clip(log_std, -20.0, 2.0))
+        self.low, self.high = low, high
+
+    def _squash(self, u):
+        t = jnp.tanh(u)
+        return self.low + (t + 1.0) * 0.5 * (self.high - self.low)
+
+    def sample_and_log_prob(self, key) -> Tuple[jax.Array, jax.Array]:
+        u = self.base.sample(key)
+        a = self._squash(u)
+        # log det of tanh + affine correction, numerically-stable softplus form
+        correction = jnp.sum(
+            2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1)
+        scale = jnp.log((self.high - self.low) * 0.5 + 1e-8)
+        logp = self.base.log_prob(u) - correction - scale * u.shape[-1]
+        return a, logp
+
+    def mode(self) -> jax.Array:
+        return self._squash(self.base.mean)

@@ -1,0 +1,179 @@
+"""EnvRunner — rollout collection on CPU vector envs (reference:
+rllib/env/single_agent_env_runner.py).
+
+Runs gymnasium vector envs and the policy's CPU forward (jax on the host
+platform — the TPU stays dedicated to the learner). Emits fixed-shape
+[T, B] SampleBatches so the learner's jitted update never recompiles.
+Deployable as a ray_tpu actor (`num_env_runners > 0`) or called inline.
+"""
+
+import functools
+from typing import Callable, Dict, List, Optional, Union
+
+import numpy as np
+
+from . import sample_batch as SB
+from .rl_module import ModuleSpec, RLModule
+from .sample_batch import SampleBatch
+
+
+def _make_vector_env(env_creator, num_envs: int):
+    import gymnasium as gym
+    try:  # classic semantics: reset obs returned in the same step as done
+        from gymnasium.vector import AutoresetMode
+        return gym.vector.SyncVectorEnv(
+            [env_creator for _ in range(num_envs)],
+            autoreset_mode=AutoresetMode.SAME_STEP)
+    except (ImportError, TypeError):
+        return gym.vector.SyncVectorEnv([env_creator for _ in range(num_envs)])
+
+
+class EnvRunner:
+    def __init__(self, env_creator: Union[str, Callable], *,
+                 num_envs: int = 1, rollout_len: int = 200,
+                 module_spec: Optional[ModuleSpec] = None,
+                 explore: bool = True, seed: int = 0,
+                 gamma: float = 0.99):
+        if isinstance(env_creator, str):
+            env_id = env_creator
+            import gymnasium as gym
+            env_creator = functools.partial(gym.make, env_id)
+        self.envs = _make_vector_env(env_creator, num_envs)
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.explore = explore
+        spec = module_spec or ModuleSpec.from_spaces(
+            self.envs.single_observation_space, self.envs.single_action_space)
+        self.module = RLModule(spec)
+        self.params = None
+        self._step_count = 0
+        self._seed = seed
+        # episode bookkeeping for metrics
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._completed: List[Dict] = []
+        self._obs = None
+        self._jit_explore = None
+        self._jit_values = None
+
+    # -- weights ------------------------------------------------------------
+    def set_weights(self, params):
+        self.params = params
+
+    def get_spec(self) -> ModuleSpec:
+        return self.module.spec
+
+    def init_params(self):
+        """Fresh params (used when the runner bootstraps the algorithm)."""
+        import jax
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            return jax.device_get(self.module.init(jax.random.PRNGKey(self._seed)))
+
+    # -- rollouts -----------------------------------------------------------
+    def _ensure_jit(self):
+        import jax
+        if self._jit_explore is None:
+            # acting runs on host CPU — the TPU belongs to the learner
+            self._cpu = jax.local_devices(backend="cpu")[0]
+
+            def explore(params, obs, key):
+                return self.module.explore_step(params, obs, key)
+
+            def infer(params, obs):
+                a, v = self.module.inference_step(params, obs)
+                return a, jax.numpy.zeros(v.shape), v
+
+            def values(params, obs):
+                _, v = self.module.forward(params, obs)
+                return v
+
+            self._jit_explore = jax.jit(explore if self.explore else
+                                        lambda p, o, k: infer(p, o))
+            self._jit_values = jax.jit(values)
+
+    def sample(self, params=None) -> SampleBatch:
+        """Collect one [T, B] rollout continuing from the last state."""
+        import jax
+        if params is not None:
+            self.params = params
+        assert self.params is not None, "set_weights() before sample()"
+        self._ensure_jit()
+        if self._obs is None:
+            self._obs, _ = self.envs.reset(seed=self._seed)
+
+        key = jax.random.PRNGKey(self._seed ^ 0x5eed)
+        with jax.default_device(self._cpu):  # acting stays off the TPU
+            return self._rollout(key)
+
+    def _rollout(self, key):
+        import jax
+        T, B = self.rollout_len, self.num_envs
+        obs_buf = np.empty((T, B) + self.envs.single_observation_space.shape,
+                           np.float32)
+        actions_buf = None
+        rewards = np.empty((T, B), np.float32)
+        dones = np.empty((T, B), np.float32)
+        terms = np.empty((T, B), np.float32)
+        logps = np.empty((T, B), np.float32)
+        vfs = np.empty((T, B), np.float32)
+        obs = self._obs
+        for t in range(T):
+            self._step_count += 1
+            k = jax.random.fold_in(key, self._step_count)
+            action, logp, value = self._jit_explore(
+                self.params, obs.astype(np.float32), k)
+            action = np.asarray(action)
+            if actions_buf is None:
+                actions_buf = np.empty((T, B) + action.shape[1:], action.dtype)
+            next_obs, rew, term, trunc, _info = self.envs.step(action)
+            obs_buf[t] = obs
+            actions_buf[t] = action
+            rewards[t] = rew
+            terms[t] = term
+            dones[t] = np.logical_or(term, trunc)
+            logps[t] = np.asarray(logp)
+            vfs[t] = np.asarray(value)
+            # metrics
+            self._ep_return += rew
+            self._ep_len += 1
+            for i in np.nonzero(dones[t])[0]:
+                self._completed.append({"return": float(self._ep_return[i]),
+                                        "len": int(self._ep_len[i])})
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+            obs = next_obs
+        self._obs = obs
+
+        # bootstrap value of the state after the last step; zero if that env
+        # terminated there (SAME_STEP autoreset → obs is the reset obs, and a
+        # terminal state's future return is 0 anyway)
+        boot = np.asarray(self._jit_values(self.params, obs.astype(np.float32)))
+        boot = boot * (1.0 - terms[-1])
+
+        return SampleBatch({
+            SB.OBS: obs_buf, SB.ACTIONS: actions_buf, SB.REWARDS: rewards,
+            SB.DONES: dones, SB.TERMINATEDS: terms, SB.LOGP: logps,
+            SB.VF_PREDS: vfs, SB.BOOTSTRAP_VALUE: boot,
+        })
+
+    # -- metrics ------------------------------------------------------------
+    def num_completed_episodes(self) -> int:
+        return len(self._completed)
+
+    def pop_metrics(self) -> Dict:
+        eps = self._completed
+        self._completed = []
+        if not eps:
+            return {"episodes_this_iter": 0}
+        rets = [e["return"] for e in eps]
+        lens = [e["len"] for e in eps]
+        return {
+            "episodes_this_iter": len(eps),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_len_mean": float(np.mean(lens)),
+        }
+
+    def close(self):
+        self.envs.close()

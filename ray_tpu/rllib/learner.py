@@ -1,0 +1,129 @@
+"""Jax Learner stack (reference: rllib/core/learner/learner.py +
+torch_learner.py).
+
+The TPU-native inversion of the reference design: instead of a torch module
+wrapped in DDP with NCCL allreduce, a Learner owns params on the default
+device (the TPU chip) and its whole update — loss, backward, optimizer — is
+ONE jitted function with donated params/opt-state. Scaling out is a mesh
+(`dp` axis) instead of extra learner processes: batches get a dp sharding and
+XLA inserts the gradient psum.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from .rl_module import RLModule
+from .sample_batch import SampleBatch
+
+
+class JaxLearner:
+    """Base learner: subclass and implement `compute_loss`."""
+
+    def __init__(self, module: RLModule, config, mesh=None, seed: int = 0):
+        import jax
+        import optax
+
+        self.module = module
+        self.config = config
+        self.mesh = mesh
+        self._metrics_keys = None
+
+        tx = []
+        clip = getattr(config, "grad_clip", None)
+        if clip:
+            tx.append(optax.clip_by_global_norm(clip))
+        tx.append(optax.adam(getattr(config, "lr", 3e-4)))
+        self.optimizer = optax.chain(*tx)
+
+        self.params = self.module.init(jax.random.PRNGKey(seed))
+        self.opt_state = self.optimizer.init(self.params)
+        self._data_sharding = None
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            repl = NamedSharding(mesh, P())
+            self.params = jax.device_put(self.params, repl)
+            self.opt_state = jax.device_put(self.opt_state, repl)
+            self._data_sharding = NamedSharding(mesh, P("dp"))
+
+        def _update(params, opt_state, batch):
+            def loss_fn(p):
+                return self.compute_loss(p, batch)
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            updates, opt_state = self.optimizer.update(grads, opt_state, params)
+            params = jax.tree_util.tree_map(lambda a, u: a + u, params, updates)
+            metrics["total_loss"] = loss
+            metrics["grad_norm"] = optax.global_norm(grads)
+            return params, opt_state, metrics
+
+        self._update = jax.jit(_update, donate_argnums=(0, 1))
+
+    # -- to implement --------------------------------------------------------
+    def compute_loss(self, params, batch) -> Tuple[Any, Dict]:
+        raise NotImplementedError
+
+    # -- update api ----------------------------------------------------------
+    def update_once(self, batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """One jitted SGD step on a (already minibatched) batch."""
+        import jax
+        if self._data_sharding is not None:
+            batch = jax.device_put(batch, self._data_sharding)
+        self.params, self.opt_state, metrics = self._update(
+            self.params, self.opt_state, batch)
+        return metrics
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        """Full update (subclasses may do epochs/minibatches); returns host
+        metrics averaged over SGD steps."""
+        return _host_metrics([self.update_once(dict(batch))])
+
+    # -- weights -------------------------------------------------------------
+    def get_weights(self):
+        import jax
+        return jax.device_get(self.params)
+
+    def set_weights(self, params):
+        import jax
+        self.params = jax.device_put(params)
+        self.opt_state = self.optimizer.init(self.params)
+
+    def get_state(self):
+        import jax
+        return {"params": jax.device_get(self.params),
+                "opt_state": jax.device_get(self.opt_state)}
+
+    def set_state(self, state):
+        import jax
+        self.params = jax.device_put(state["params"])
+        self.opt_state = jax.device_put(state["opt_state"])
+
+
+def _host_metrics(steps) -> Dict[str, float]:
+    import jax
+    if not steps:
+        return {}
+    host = [jax.device_get(m) for m in steps]
+    return {k: float(np.mean([m[k] for m in host])) for k in host[0]}
+
+
+class LearnerGroup:
+    """One learner per host (reference: rllib LearnerGroup over NCCL).
+
+    Round 1 binds a single local learner; the multi-host path (one process
+    per host under jax.distributed, same jitted update, grads psum over the
+    dp mesh axis) shares this interface.
+    """
+
+    def __init__(self, learner: JaxLearner):
+        self.learner = learner
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        return self.learner.update(batch)
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, w):
+        self.learner.set_weights(w)

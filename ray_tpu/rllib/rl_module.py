@@ -1,0 +1,103 @@
+"""RLModule — policy/value network + action-distribution glue (reference:
+rllib/core/rl_module/rl_module.py + catalog).
+
+A module is a flax net mapping obs → (dist inputs, value). The catalog picks
+the torso (MLP for flat obs, CNN for image obs) and the head for the action
+space (Discrete → Categorical logits; Box → mean + learned log_std).
+"""
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.torsos import CNNTorso, MLPTorso
+from .distributions import Categorical, DiagGaussian
+
+
+@dataclasses.dataclass
+class ModuleSpec:
+    """What the catalog derived from the spaces (pickles cleanly to actors)."""
+    obs_shape: Tuple[int, ...]
+    action_kind: str          # "discrete" | "continuous"
+    action_dim: int
+    hiddens: Sequence[int] = (256, 256)
+    use_cnn: bool = False
+    free_log_std: bool = True
+
+    @staticmethod
+    def from_spaces(obs_space, action_space, hiddens=(256, 256)) -> "ModuleSpec":
+        import gymnasium as gym
+        obs_shape = tuple(obs_space.shape)
+        use_cnn = len(obs_shape) == 3
+        if isinstance(action_space, gym.spaces.Discrete):
+            return ModuleSpec(obs_shape, "discrete", int(action_space.n),
+                              hiddens, use_cnn)
+        if isinstance(action_space, gym.spaces.Box):
+            return ModuleSpec(obs_shape, "continuous",
+                              int(np.prod(action_space.shape)), hiddens, use_cnn)
+        raise ValueError(f"unsupported action space {action_space}")
+
+
+class PolicyValueNet(nn.Module):
+    """Shared-torso actor-critic net: obs → (dist_inputs, value)."""
+    spec: ModuleSpec
+
+    @nn.compact
+    def __call__(self, obs):
+        spec = self.spec
+        torso = CNNTorso() if spec.use_cnn else MLPTorso(spec.hiddens)
+        z = torso(obs)
+        out_dim = (spec.action_dim if spec.action_kind == "discrete"
+                   else spec.action_dim)
+        dist_in = nn.Dense(out_dim, name="pi",
+                           kernel_init=nn.initializers.orthogonal(0.01))(z)
+        if spec.action_kind == "continuous" and spec.free_log_std:
+            log_std = self.param("log_std", nn.initializers.zeros,
+                                 (spec.action_dim,), jnp.float32)
+            dist_in = jnp.concatenate(
+                [dist_in, jnp.broadcast_to(log_std, dist_in.shape)], -1)
+        value = nn.Dense(1, name="vf",
+                         kernel_init=nn.initializers.orthogonal(1.0))(z)[..., 0]
+        return dist_in, value
+
+
+class RLModule:
+    """Bundles net defs + dist construction; stateless (params passed in)."""
+
+    def __init__(self, spec: ModuleSpec):
+        self.spec = spec
+        self.net = PolicyValueNet(spec)
+
+    def init(self, key) -> Any:
+        obs = jnp.zeros((1,) + self.spec.obs_shape, jnp.float32)
+        return self.net.init(key, obs)
+
+    def dist(self, dist_inputs: jax.Array):
+        if self.spec.action_kind == "discrete":
+            return Categorical(dist_inputs)
+        mean, log_std = jnp.split(dist_inputs, 2, axis=-1)
+        return DiagGaussian(mean, log_std)
+
+    def forward(self, params, obs) -> Tuple[jax.Array, jax.Array]:
+        """obs [..., *obs_shape] → (dist_inputs, value); flattens leading dims
+        so [T, B, ...] rollouts work without reshaping at call sites."""
+        lead = obs.shape[: obs.ndim - len(self.spec.obs_shape)]
+        flat = obs.reshape((-1,) + self.spec.obs_shape)
+        dist_in, value = self.net.apply(params, flat)
+        return (dist_in.reshape(lead + dist_in.shape[1:]),
+                value.reshape(lead))
+
+    def explore_step(self, params, obs, key):
+        """One acting step: sample action, return (action, logp, value)."""
+        dist_in, value = self.forward(params, obs)
+        dist = self.dist(dist_in)
+        action = dist.sample(key)
+        return action, dist.log_prob(action), value
+
+    def inference_step(self, params, obs):
+        dist_in, value = self.forward(params, obs)
+        return self.dist(dist_in).mode(), value

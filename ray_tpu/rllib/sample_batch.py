@@ -1,0 +1,74 @@
+"""SampleBatch — the rollout data container (reference:
+rllib/policy/sample_batch.py).
+
+A dict of numpy/jax arrays with standard column names. Rollout batches are
+[T, B, ...] (time-major: the GAE scan runs over axis 0 without transposes);
+`flatten()` collapses to [T*B, ...] for SGD minibatching. All shapes are
+static per config so the learner's jitted update never recompiles.
+"""
+
+from typing import Dict, Iterator, List
+
+import numpy as np
+
+OBS = "obs"
+NEXT_OBS = "next_obs"
+ACTIONS = "actions"
+REWARDS = "rewards"
+TERMINATEDS = "terminateds"
+TRUNCATEDS = "truncateds"
+DONES = "dones"
+LOGP = "action_logp"
+VF_PREDS = "vf_preds"
+ADVANTAGES = "advantages"
+VALUE_TARGETS = "value_targets"
+BOOTSTRAP_VALUE = "bootstrap_value"
+
+
+class SampleBatch(dict):
+    @property
+    def count(self) -> int:
+        for v in self.values():
+            if hasattr(v, "shape") and v.ndim >= 1:
+                return int(np.prod(v.shape[:1]))
+        return 0
+
+    def flatten(self) -> "SampleBatch":
+        """[T, B, ...] → [T*B, ...] (skips scalar entries)."""
+        out = SampleBatch()
+        for k, v in self.items():
+            v = np.asarray(v)
+            out[k] = v.reshape((-1,) + v.shape[2:]) if v.ndim >= 2 else v
+        return out
+
+    def shuffle(self, rng: np.random.Generator) -> "SampleBatch":
+        n = self.count
+        perm = rng.permutation(n)
+        return SampleBatch({k: np.asarray(v)[perm] if np.asarray(v).ndim >= 1
+                            else v for k, v in self.items()})
+
+    def minibatches(self, size: int) -> Iterator["SampleBatch"]:
+        n = self.count
+        for i in range(0, n - size + 1, size):
+            yield SampleBatch({k: np.asarray(v)[i:i + size]
+                               if np.asarray(v).ndim >= 1 else v
+                               for k, v in self.items()})
+
+    @staticmethod
+    def concat(batches: List["SampleBatch"], axis: int = 1) -> "SampleBatch":
+        """Concat rollouts from several runners along the env/batch axis."""
+        if len(batches) == 1:
+            return batches[0]
+        keys = batches[0].keys()
+        out = SampleBatch()
+        for k in keys:
+            vs = [np.asarray(b[k]) for b in batches]
+            out[k] = (np.concatenate(vs, axis=axis if vs[0].ndim > axis else 0)
+                      if vs[0].ndim >= 1 else vs[0])
+        return out
+
+    def to_device(self, sharding=None):
+        import jax
+        arrs = {k: np.asarray(v) for k, v in self.items()}
+        return (jax.device_put(arrs, sharding) if sharding is not None
+                else jax.device_put(arrs))
