@@ -1,0 +1,17 @@
+"""ray_tpu.serve — TPU-native model serving (reference: python/ray/serve).
+
+Deployments are async replica actors; handles route with power-of-two-choices;
+`@serve.batch` coalesces requests into jit-friendly batches; `serve/llm.py`
+adds a continuous-batching LLM replica on a jitted decode step.
+"""
+
+from .api import delete, get_deployment_handle, run, shutdown, status
+from .batching import batch
+from .deployment import AutoscalingConfig, Deployment, DeploymentConfig, deployment
+from .handle import DeploymentHandle, DeploymentResponse
+
+__all__ = [
+    "AutoscalingConfig", "Deployment", "DeploymentConfig", "DeploymentHandle",
+    "DeploymentResponse", "batch", "delete", "deployment",
+    "get_deployment_handle", "run", "shutdown", "status",
+]

@@ -1,0 +1,89 @@
+"""serve.run / serve.delete / handles (reference: python/ray/serve/api.py).
+
+`run()` walks the bound DAG bottom-up, registers each deployment with the
+controller actor (which spawns replica actors), and returns a handle to the
+root. No HTTP in round 1 — the handle API is the ingress; an asyncio proxy
+rides on it.
+"""
+
+from typing import Dict, Optional
+
+import cloudpickle
+
+from .controller import get_controller
+from .deployment import BoundDeployment, Deployment
+from .handle import DeploymentHandle
+
+
+def run(target: BoundDeployment, *, name: str = "default",
+        route_prefix: Optional[str] = None, blocking: bool = False,
+        _autoscale_interval_s: Optional[float] = 2.0) -> DeploymentHandle:
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    if not isinstance(target, BoundDeployment):
+        raise TypeError("serve.run takes a bound deployment: dep.bind(...)")
+    ctrl = get_controller()
+
+    handles: Dict[int, DeploymentHandle] = {}
+    any_autoscaling = False
+    for node in target.walk():
+        dep: Deployment = node.deployment
+
+        def resolve(v):
+            if isinstance(v, BoundDeployment):
+                return handles[id(v)]
+            return v
+
+        args = tuple(resolve(a) for a in node.args)
+        kwargs = {k: resolve(v) for k, v in node.kwargs.items()}
+        blob = cloudpickle.dumps(dep._callable)
+        ray_tpu.get(ctrl.register_deployment.remote(
+            name, dep.name, blob, args, kwargs, dep.config))
+        handles[id(node)] = DeploymentHandle(dep.name, name)
+        any_autoscaling = any_autoscaling or dep.config.autoscaling_config
+
+    if any_autoscaling and _autoscale_interval_s:
+        ray_tpu.get(ctrl.start_autoscaler.remote(_autoscale_interval_s))
+    return handles[id(target)]
+
+
+def delete(name: str = "default") -> None:
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        return
+    try:
+        ctrl = get_controller()
+        ray_tpu.get(ctrl.delete_app.remote(name))
+    except Exception:  # noqa: BLE001 - nothing deployed
+        pass
+
+
+def get_deployment_handle(deployment_name: str,
+                          app_name: str = "default") -> DeploymentHandle:
+    return DeploymentHandle(deployment_name, app_name)
+
+
+def shutdown() -> None:
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        return
+    try:
+        ctrl = get_controller()
+        import ray_tpu as rt
+        for app in ("default",):
+            rt.get(ctrl.delete_app.remote(app))
+        rt.kill(ctrl)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+def status() -> Dict:
+    import ray_tpu
+    ctrl = get_controller()
+    out = {}
+    for app in ("default",):
+        for dep in ray_tpu.get(ctrl.list_deployments.remote(app)):
+            out[f"{app}:{dep}"] = {
+                "replicas": ray_tpu.get(ctrl.num_replicas.remote(app, dep))}
+    return out

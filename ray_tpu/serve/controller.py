@@ -1,0 +1,141 @@
+"""Serve controller (reference: serve/_private/controller.py ServeController
+actor) — registry of apps → deployments → replica actor handles, plus the
+autoscaling decision loop.
+"""
+
+import asyncio
+import math
+import time
+from typing import Dict, List, Optional
+
+CONTROLLER_NAME = "SERVE_CONTROLLER"
+
+
+class ServeController:
+    def __init__(self):
+        # {app: {deployment: {"replicas": [handles], "config": DeploymentConfig,
+        #        "blob": bytes, "init": (args, kwargs), "version": int}}}
+        self.apps: Dict[str, Dict[str, Dict]] = {}
+        self._autoscale_task = None
+
+    # -- registry ------------------------------------------------------------
+    def register_deployment(self, app: str, name: str, blob, init_args,
+                            init_kwargs, config) -> None:
+        self.apps.setdefault(app, {})[name] = {
+            "replicas": [], "config": config, "blob": blob,
+            "init": (init_args, init_kwargs), "version": 0,
+            "last_scale_ts": 0.0,
+        }
+        self._scale_to(app, name, config.num_replicas)
+
+    def delete_app(self, app: str) -> None:
+        import ray_tpu
+        for name, rec in self.apps.pop(app, {}).items():
+            for h in rec["replicas"]:
+                try:
+                    ray_tpu.kill(h)
+                except Exception:  # noqa: BLE001 - already dead
+                    pass
+
+    def list_deployments(self, app: str) -> List[str]:
+        return list(self.apps.get(app, {}))
+
+    def get_replicas(self, app: str, name: str):
+        return self.apps[app][name]["replicas"]
+
+    def get_version(self, app: str, name: str) -> int:
+        rec = self.apps.get(app, {}).get(name)
+        return -1 if rec is None else rec["version"]
+
+    def num_replicas(self, app: str, name: str) -> int:
+        return len(self.apps[app][name]["replicas"])
+
+    # -- scaling -------------------------------------------------------------
+    def _scale_to(self, app: str, name: str, target: int) -> None:
+        import ray_tpu
+        from .replica import Replica
+
+        rec = self.apps[app][name]
+        cfg = rec["config"]
+        replicas = rec["replicas"]
+        while len(replicas) < target:
+            idx = len(replicas)
+            opts = dict(cfg.ray_actor_options or {})
+            opts.setdefault("max_concurrency", cfg.max_ongoing_requests)
+            opts["name"] = f"SERVE::{app}::{name}#{idx}"
+            actor_cls = ray_tpu.remote(**opts)(Replica)
+            args, kwargs = rec["init"]
+            replicas.append(actor_cls.remote(rec["blob"], args, kwargs,
+                                             cfg.user_config))
+        while len(replicas) > target:
+            h = replicas.pop()
+            try:
+                ray_tpu.kill(h)
+            except Exception:  # noqa: BLE001
+                pass
+        rec["version"] += 1
+        rec["last_scale_ts"] = time.time()
+
+    def autoscale_once(self) -> Dict[str, int]:
+        """One pass of the autoscaler over every deployment; returns the new
+        replica counts. Policy (reference: serve autoscaling_policy.py):
+        desired = ceil(total_ongoing / target_ongoing_requests)."""
+        import ray_tpu
+        decisions = {}
+        for app, deps in self.apps.items():
+            for name, rec in deps.items():
+                auto = rec["config"].autoscaling_config
+                if auto is None:
+                    continue
+                stats = []
+                for h in rec["replicas"]:
+                    try:
+                        stats.append(ray_tpu.get(h.stats.remote(), timeout=5))
+                    except Exception:  # noqa: BLE001 - replica restarting
+                        pass
+                ongoing = sum(s["ongoing"] for s in stats)
+                desired = decide_num_replicas(
+                    ongoing, len(rec["replicas"]), auto)
+                decisions[f"{app}:{name}"] = desired
+                if desired != len(rec["replicas"]):
+                    self._scale_to(app, name, desired)
+        return decisions
+
+    async def run_autoscaler(self, interval_s: float = 2.0):
+        while True:
+            await asyncio.sleep(interval_s)
+            self.autoscale_once()
+
+    async def start_autoscaler(self, interval_s: float = 2.0):
+        # async → runs on the actor's asyncio loop, so the task lives there
+        if self._autoscale_task is None:
+            self._autoscale_task = asyncio.get_running_loop().create_task(
+                self.run_autoscaler(interval_s))
+        return True
+
+    def ping(self):
+        return "pong"
+
+
+def decide_num_replicas(total_ongoing: float, current: int, auto) -> int:
+    """Pure autoscaling decision (unit-testable): scale toward
+    total_ongoing / target, clamped to [min_replicas, max_replicas]."""
+    if current == 0:
+        return max(auto.min_replicas, 1)
+    desired = math.ceil(total_ongoing / max(auto.target_ongoing_requests, 1e-9))
+    return int(min(max(desired, auto.min_replicas), auto.max_replicas))
+
+
+def get_controller():
+    """The named controller actor, creating it on first use."""
+    import ray_tpu
+    try:
+        return ray_tpu.get_actor(CONTROLLER_NAME)
+    except ValueError:
+        pass
+    ctrl = ray_tpu.remote(num_cpus=0, max_concurrency=16,
+                          name=CONTROLLER_NAME)(ServeController).remote()
+    # materialize creation before handing out (racing callers get_actor)
+    import ray_tpu as rt
+    rt.get(ctrl.ping.remote())
+    return ctrl

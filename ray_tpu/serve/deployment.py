@@ -1,0 +1,108 @@
+"""Deployment + application DAG (reference: python/ray/serve/deployment.py,
+serve/_private/deployment_graph_build.py).
+
+`@serve.deployment` wraps a class; `.bind(*args)` builds a DAG node whose
+arguments may themselves be bound deployments — `serve.run` instantiates the
+graph bottom-up, replacing bound children with DeploymentHandles.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass
+class AutoscalingConfig:
+    min_replicas: int = 1
+    max_replicas: int = 1
+    target_ongoing_requests: float = 2.0
+    upscale_delay_s: float = 0.0
+    downscale_delay_s: float = 0.0
+
+
+@dataclasses.dataclass
+class DeploymentConfig:
+    num_replicas: int = 1
+    max_ongoing_requests: int = 8
+    user_config: Any = None
+    ray_actor_options: Dict = dataclasses.field(default_factory=dict)
+    autoscaling_config: Optional[AutoscalingConfig] = None
+    health_check_period_s: float = 10.0
+
+
+class Deployment:
+    def __init__(self, cls_or_fn, name: Optional[str] = None,
+                 config: Optional[DeploymentConfig] = None):
+        self._callable = cls_or_fn
+        self.name = name or getattr(cls_or_fn, "__name__", "deployment")
+        self.config = config or DeploymentConfig()
+
+    def options(self, *, num_replicas=None, max_ongoing_requests=None,
+                user_config=None, ray_actor_options=None, name=None,
+                autoscaling_config=None, **_compat):
+        cfg = dataclasses.replace(self.config)
+        if num_replicas is not None:
+            cfg.num_replicas = num_replicas
+        if max_ongoing_requests is not None:
+            cfg.max_ongoing_requests = max_ongoing_requests
+        if user_config is not None:
+            cfg.user_config = user_config
+        if ray_actor_options is not None:
+            cfg.ray_actor_options = dict(ray_actor_options)
+        if autoscaling_config is not None:
+            if isinstance(autoscaling_config, dict):
+                autoscaling_config = AutoscalingConfig(**autoscaling_config)
+            cfg.autoscaling_config = autoscaling_config
+            cfg.num_replicas = max(cfg.num_replicas,
+                                   autoscaling_config.min_replicas)
+        return Deployment(self._callable, name or self.name, cfg)
+
+    def bind(self, *args, **kwargs) -> "BoundDeployment":
+        return BoundDeployment(self, args, kwargs)
+
+    def __call__(self, *a, **k):
+        raise TypeError(
+            f"Deployment '{self.name}' can't be called directly; deploy it "
+            f"with serve.run(dep.bind(...)) and use the handle.")
+
+
+class BoundDeployment:
+    """A DAG node: deployment + init args (which may contain other nodes)."""
+
+    def __init__(self, deployment: Deployment, args: Tuple, kwargs: Dict):
+        self.deployment = deployment
+        self.args = args
+        self.kwargs = kwargs
+
+    def walk(self):
+        """Yield nodes bottom-up (children before parents), deduplicated."""
+        seen = set()
+
+        def _walk(node):
+            for a in list(node.args) + list(node.kwargs.values()):
+                if isinstance(a, BoundDeployment):
+                    yield from _walk(a)
+            if id(node) not in seen:
+                seen.add(id(node))
+                yield node
+
+        yield from _walk(self)
+
+
+def deployment(cls_or_fn=None, *, name=None, num_replicas=None,
+               max_ongoing_requests=None, user_config=None,
+               ray_actor_options=None, autoscaling_config=None, **_compat):
+    """@serve.deployment decorator (bare or with options)."""
+
+    def wrap(target) -> Deployment:
+        dep = Deployment(target, name)
+        return dep.options(
+            num_replicas=num_replicas,
+            max_ongoing_requests=max_ongoing_requests,
+            user_config=user_config,
+            ray_actor_options=ray_actor_options,
+            autoscaling_config=autoscaling_config,
+        )
+
+    if cls_or_fn is not None:
+        return wrap(cls_or_fn)
+    return wrap

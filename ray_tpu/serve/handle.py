@@ -1,0 +1,137 @@
+"""DeploymentHandle + router (reference: serve/handle.py and
+serve/_private/router.py "power of two choices" replica scheduler).
+
+A handle is cheap, pickleable (rebinds to replicas by name via the serve
+controller actor), and routes each `.remote()` with p2c: sample two replicas,
+send to the one with fewer requests this handle has in flight.
+"""
+
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+
+class DeploymentResponse:
+    """Future for one request (reference: serve.handle.DeploymentResponse)."""
+
+    def __init__(self, ref):
+        self._ref = ref
+
+    def result(self, timeout_s: Optional[float] = None):
+        import ray_tpu
+        return ray_tpu.get(self._ref, timeout=timeout_s)
+
+    def __await__(self):
+        return self._ref.__await__()
+
+    @property
+    def object_ref(self):
+        return self._ref
+
+
+class DeploymentResponseGenerator:
+    """Streaming response: iterate results as the replica yields them."""
+
+    def __init__(self, gen):
+        self._gen = gen
+
+    def __iter__(self):
+        import ray_tpu
+        for ref in self._gen:
+            yield ray_tpu.get(ref)
+
+    async def __aiter__(self):
+        import ray_tpu
+        async for ref in self._gen:
+            yield await ref
+
+
+class DeploymentHandle:
+    def __init__(self, deployment_name: str, app_name: str = "default",
+                 method_name: str = "__call__", stream: bool = False):
+        self.deployment_name = deployment_name
+        self.app_name = app_name
+        self._method_name = method_name
+        self._stream = stream
+        self._replicas: List = []
+        self._inflight: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._version = -1
+        self._last_refresh = 0.0
+
+    # -- construction / refresh ---------------------------------------------
+    def options(self, *, method_name: Optional[str] = None,
+                stream: Optional[bool] = None, **_compat) -> "DeploymentHandle":
+        h = DeploymentHandle(self.deployment_name, self.app_name,
+                             method_name or self._method_name,
+                             self._stream if stream is None else stream)
+        h._replicas = self._replicas
+        h._inflight = self._inflight
+        h._version = self._version
+        return h
+
+    # bound per-request controller chatter; scale-ups are picked up within
+    # this window
+    _REFRESH_TTL_S = 0.5
+
+    def _refresh(self, force: bool = False):
+        import time
+        if (self._replicas and not force
+                and time.monotonic() - self._last_refresh < self._REFRESH_TTL_S):
+            return
+        from .controller import get_controller
+        ctrl = get_controller()
+        import ray_tpu
+        version = ray_tpu.get(ctrl.get_version.remote(self.app_name,
+                                                      self.deployment_name))
+        if version != self._version or force:
+            self._replicas = ray_tpu.get(
+                ctrl.get_replicas.remote(self.app_name, self.deployment_name))
+            self._version = version
+            with self._lock:
+                self._inflight = {i: 0 for i in range(len(self._replicas))}
+        self._last_refresh = time.monotonic()
+
+    # -- routing -------------------------------------------------------------
+    def _pick_replica(self) -> int:
+        """Power of two choices on this handle's in-flight counts."""
+        n = len(self._replicas)
+        if n == 1:
+            return 0
+        with self._lock:
+            a, b = random.sample(range(n), 2)
+            return a if self._inflight.get(a, 0) <= self._inflight.get(b, 0) else b
+
+    def remote(self, *args, **kwargs):
+        self._refresh()
+        if not self._replicas:
+            raise RuntimeError(
+                f"deployment '{self.deployment_name}' has no replicas")
+        idx = self._pick_replica()
+        replica = self._replicas[idx]
+        with self._lock:
+            self._inflight[idx] = self._inflight.get(idx, 0) + 1
+
+        def _done(_f):
+            with self._lock:
+                self._inflight[idx] = max(self._inflight.get(idx, 1) - 1, 0)
+
+        if self._stream:
+            gen = replica.handle_request_streaming.options(
+                num_returns="streaming").remote(self._method_name, *args, **kwargs)
+            return DeploymentResponseGenerator(gen)
+        ref = replica.handle_request.remote(self._method_name, *args, **kwargs)
+        try:
+            ref.future().add_done_callback(_done)
+        except Exception:  # noqa: BLE001 - counter decay is best-effort
+            pass
+        return DeploymentResponse(ref)
+
+    def __getattr__(self, item):
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return self.options(method_name=item)
+
+    def __reduce__(self):
+        return (DeploymentHandle, (self.deployment_name, self.app_name,
+                                   self._method_name, self._stream))

@@ -1,0 +1,237 @@
+"""LLM serving replica — continuous batching on a jitted decode step
+(reference: ray serve LLM examples / serve/llm vLLM integration; re-designed
+TPU-first instead of wrapping vLLM's CUDA paged attention).
+
+Design: B decode slots over a static-shape KVCache ([B, Smax] per layer,
+per-row lengths). Requests are admitted into free slots (prefill fills the
+row's cache), and ONE jitted decode step advances every active slot each
+tick — XLA sees the same [B, 1] program forever, no recompiles, while
+requests join/leave between ticks (continuous batching). Sampling is
+temperature/top-k on-device.
+
+The per-row `length` mask plays the role of vLLM's page table in round 1:
+slot rows are the "pages", eviction = slot free. A pallas paged-attention
+kernel over a real block table is the round-2 upgrade path.
+"""
+
+import asyncio
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LLMConfig:
+    preset: str = "tiny"            # LlamaConfig preset name
+    max_batch_slots: int = 8        # concurrent decode slots (B)
+    max_seq_len: int = 512          # Smax (prompt + generation)
+    temperature: float = 0.0        # 0 → greedy
+    top_k: int = 0                  # 0 → full softmax
+    param_dtype: str = "bfloat16"
+    seed: int = 0
+
+
+@dataclasses.dataclass
+class _Slot:
+    request_id: int
+    prompt_len: int
+    max_tokens: int
+    generated: List[int]
+    done_event: asyncio.Event
+    stream_queue: Optional[asyncio.Queue] = None
+    eos_id: Optional[int] = None
+
+
+class LLMServer:
+    """Deployment class: `generate(prompt_ids, max_tokens)` → token ids.
+
+    Works on token ids; wrap with a tokenizer deployment for text. Designed
+    to run as `@serve.deployment(ray_actor_options={"num_tpus": 1})`.
+    """
+
+    def __init__(self, config: Optional[LLMConfig] = None, params=None):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.models.llama import KVCache, Llama, LlamaConfig
+
+        self.config = cfg = config or LLMConfig()
+        preset = getattr(LlamaConfig, cfg.preset)
+        self.model_cfg = preset(max_seq_len=cfg.max_seq_len,
+                                param_dtype=getattr(jnp, cfg.param_dtype))
+        self.model = Llama(self.model_cfg)
+        B = cfg.max_batch_slots
+        key = jax.random.PRNGKey(cfg.seed)
+        if params is None:
+            params = self.model.init(
+                key, jnp.zeros((1, 8), jnp.int32))
+        self.params = jax.device_put(params)
+        self.cache = KVCache.init(self.model_cfg, B, cfg.max_seq_len)
+        self._active: Dict[int, _Slot] = {}   # slot idx -> request state
+        self._free = list(range(B))
+        self._req_counter = 0
+        self._tick_task = None
+        self._sample_key = key
+        self._build_fns()
+
+    # -- jitted programs -----------------------------------------------------
+    def _build_fns(self):
+        import jax
+        import jax.numpy as jnp
+        from ray_tpu.models.llama import KVCache
+
+        cfg = self.config
+        model = self.model
+
+        def prefill_row(params, cache, tokens, slot, true_len):
+            """Write a (padded) prompt's KV into `slot`'s row; return next
+            token logits for that row. tokens: [1, P] padded to a bucket.
+            `slot` is traced (one compile per prompt bucket, not per slot)."""
+            row_cache = KVCache(
+                k=tuple(jax.lax.dynamic_slice_in_dim(c, slot, 1, 0)
+                        for c in cache.k),
+                v=tuple(jax.lax.dynamic_slice_in_dim(c, slot, 1, 0)
+                        for c in cache.v),
+                length=jnp.zeros((1,), jnp.int32))
+            logits, new_row = model.apply(params, tokens, cache=row_cache)
+            k = tuple(jax.lax.dynamic_update_index_in_dim(c, nc[0], slot, 0)
+                      for c, nc in zip(cache.k, new_row.k))
+            v = tuple(jax.lax.dynamic_update_index_in_dim(c, nc[0], slot, 0)
+                      for c, nc in zip(cache.v, new_row.v))
+            length = cache.length.at[slot].set(true_len)
+            last = logits[0, true_len - 1]
+            return KVCache(k=k, v=v, length=length), last
+
+        def decode_step(params, cache, last_tokens, active_mask, key):
+            """One token for every slot: [B, 1] forward + sample."""
+            logits, new_cache = model.apply(params, last_tokens, cache=cache)
+            logits = logits[:, -1, :]  # [B, V]
+            if cfg.temperature > 0:
+                scaled = logits / cfg.temperature
+                if cfg.top_k > 0:
+                    kth = jnp.sort(scaled, axis=-1)[:, -cfg.top_k][:, None]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                nxt = jax.random.categorical(key, scaled, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1)
+            # inactive slots must not advance their cache row
+            length = jnp.where(active_mask, new_cache.length, cache.length)
+            new_cache = KVCache(k=new_cache.k, v=new_cache.v, length=length)
+            return new_cache, nxt.astype(jnp.int32)
+
+        self._prefill = jax.jit(prefill_row, donate_argnums=(1,),
+                                static_argnums=())
+        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+
+    @staticmethod
+    def _bucket(n: int) -> int:
+        """Pad prompt lengths to power-of-two buckets: few compiled prefill
+        variants instead of one per length."""
+        b = 16
+        while b < n:
+            b *= 2
+        return b
+
+    # -- request admission ---------------------------------------------------
+    async def _admit(self, prompt_ids: List[int], max_tokens: int,
+                     eos_id: Optional[int], stream: bool) -> _Slot:
+        import jax.numpy as jnp
+
+        while not self._free:
+            await asyncio.sleep(0.005)
+        slot_idx = self._free.pop()
+        self._req_counter += 1
+        P = len(prompt_ids)
+        if P + max_tokens > self.config.max_seq_len:
+            self._free.append(slot_idx)
+            raise ValueError(
+                f"prompt({P}) + max_tokens({max_tokens}) exceeds "
+                f"max_seq_len({self.config.max_seq_len})")
+        bucket = self._bucket(P)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :P] = prompt_ids
+        self.cache, last_logits = self._prefill(
+            self.params, self.cache, jnp.asarray(padded), slot_idx, P)
+        first = int(np.argmax(np.asarray(last_logits)))
+        slot = _Slot(request_id=self._req_counter, prompt_len=P,
+                     max_tokens=max_tokens, generated=[first],
+                     done_event=asyncio.Event(),
+                     stream_queue=asyncio.Queue() if stream else None,
+                     eos_id=eos_id)
+        if stream:
+            slot.stream_queue.put_nowait(first)
+        self._active[slot_idx] = slot
+        self._ensure_tick_loop()
+        return slot
+
+    def _ensure_tick_loop(self):
+        if self._tick_task is None or self._tick_task.done():
+            self._tick_task = asyncio.get_running_loop().create_task(
+                self._tick_loop())
+
+    async def _tick_loop(self):
+        """The continuous-batching engine: one decode step per iteration
+        while any slot is active; frees slots as requests finish."""
+        import jax
+        import jax.numpy as jnp
+
+        B = self.config.max_batch_slots
+        while self._active:
+            last = np.zeros((B, 1), np.int32)
+            mask = np.zeros((B,), bool)
+            for i, slot in self._active.items():
+                last[i, 0] = slot.generated[-1]
+                mask[i] = True
+            self._sample_key, sub = jax.random.split(self._sample_key)
+            self.cache, nxt = self._decode(
+                self.params, self.cache, jnp.asarray(last),
+                jnp.asarray(mask), sub)
+            nxt = np.asarray(jax.device_get(nxt))
+            finished = []
+            for i, slot in self._active.items():
+                tok = int(nxt[i])
+                slot.generated.append(tok)
+                if slot.stream_queue is not None:
+                    slot.stream_queue.put_nowait(tok)
+                hit_eos = slot.eos_id is not None and tok == slot.eos_id
+                total = slot.prompt_len + len(slot.generated)
+                if (len(slot.generated) >= slot.max_tokens or hit_eos
+                        or total >= self.config.max_seq_len):
+                    finished.append(i)
+            for i in finished:
+                slot = self._active.pop(i)
+                slot.done_event.set()
+                if slot.stream_queue is not None:
+                    slot.stream_queue.put_nowait(None)
+                self._free.append(i)
+            await asyncio.sleep(0)  # let admits interleave between ticks
+
+    # -- public api ----------------------------------------------------------
+    async def generate(self, prompt_ids: List[int], max_tokens: int = 32,
+                       eos_id: Optional[int] = None) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        slot = await self._admit(list(prompt_ids), max_tokens, eos_id, False)
+        ttft = time.perf_counter() - t0
+        await slot.done_event.wait()
+        toks = slot.generated[:max_tokens]
+        if eos_id is not None and eos_id in toks:
+            toks = toks[:toks.index(eos_id)]
+        return {"tokens": toks, "ttft_s": ttft,
+                "total_s": time.perf_counter() - t0}
+
+    async def generate_stream(self, prompt_ids: List[int],
+                              max_tokens: int = 32,
+                              eos_id: Optional[int] = None):
+        slot = await self._admit(list(prompt_ids), max_tokens, eos_id, True)
+        emitted = 0
+        while emitted < max_tokens:
+            tok = await slot.stream_queue.get()
+            if tok is None or (eos_id is not None and tok == eos_id):
+                break
+            emitted += 1
+            yield tok
+
+    def stats(self) -> Dict[str, int]:
+        return {"active": len(self._active), "free_slots": len(self._free),
+                "requests": self._req_counter}

@@ -1,0 +1,76 @@
+"""Replica actor wrapper (reference: serve/_private/replica.py).
+
+Each replica is an async ray_tpu actor hosting one instance of the user's
+deployment class. Requests arrive as `handle_request` method calls; the
+actor's asyncio loop gives intra-replica concurrency up to
+max_ongoing_requests, and `@serve.batch` methods coalesce on that loop.
+"""
+
+import inspect
+
+
+class Replica:
+    def __init__(self, cls_blob_or_cls, init_args, init_kwargs,
+                 user_config=None):
+        import cloudpickle
+        cls = (cloudpickle.loads(cls_blob_or_cls)
+               if isinstance(cls_blob_or_cls, bytes) else cls_blob_or_cls)
+        if inspect.isclass(cls):
+            self.instance = cls(*init_args, **init_kwargs)
+        else:
+            # function deployment: calls go to __call__
+            self.instance = _FnWrapper(cls)
+        self._ongoing = 0
+        self._total = 0
+        if user_config is not None:
+            self.reconfigure(user_config)
+
+    def reconfigure(self, user_config):
+        fn = getattr(self.instance, "reconfigure", None)
+        if fn is not None:
+            fn(user_config)
+
+    async def handle_request(self, method_name, *args, **kwargs):
+        self._ongoing += 1
+        self._total += 1
+        try:
+            fn = getattr(self.instance, method_name)
+            out = fn(*args, **kwargs)
+            if inspect.iscoroutine(out):
+                out = await out
+            return out
+        finally:
+            self._ongoing -= 1
+
+    async def handle_request_streaming(self, method_name, *args, **kwargs):
+        """Generator methods: yield items (streams via ObjectRefGenerator)."""
+        self._ongoing += 1
+        self._total += 1
+        try:
+            fn = getattr(self.instance, method_name)
+            out = fn(*args, **kwargs)
+            if inspect.isasyncgen(out):
+                async for item in out:
+                    yield item
+            else:
+                for item in out:
+                    yield item
+        finally:
+            self._ongoing -= 1
+
+    def stats(self):
+        return {"ongoing": self._ongoing, "total": self._total}
+
+    def health_check(self):
+        fn = getattr(self.instance, "check_health", None)
+        if fn is not None:
+            fn()
+        return True
+
+
+class _FnWrapper:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, *a, **k):
+        return self._fn(*a, **k)
