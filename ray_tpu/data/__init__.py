@@ -1,0 +1,20 @@
+"""ray_tpu.data — TPU-native data library (reference: python/ray/data).
+
+Lazy Datasets over pyarrow blocks; per-block transforms fuse and run as
+tasks; iter_device_batches double-buffers host→HBM for the training loop.
+"""
+
+from .dataset import Dataset, GroupedData, from_blocks
+from .datasource import (from_arrow, from_items, from_numpy, from_pandas,
+                         range, read_binary_files, read_csv, read_json,
+                         read_parquet, read_text)
+from .preprocessors import (BatchMapper, Chain, Concatenator, LabelEncoder,
+                            MinMaxScaler, Preprocessor, StandardScaler)
+
+__all__ = [
+    "Dataset", "GroupedData", "from_blocks", "from_items", "from_numpy",
+    "from_pandas", "from_arrow", "range", "read_parquet", "read_csv",
+    "read_json", "read_text", "read_binary_files", "Preprocessor",
+    "BatchMapper", "StandardScaler", "MinMaxScaler", "LabelEncoder",
+    "Concatenator", "Chain",
+]

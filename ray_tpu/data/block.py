@@ -1,0 +1,127 @@
+"""Block utilities (reference: python/ray/data/block.py + _internal/arrow_block.py).
+
+The canonical block is a pyarrow.Table — zero-copy into numpy for the
+device path, columnar for transforms. Rows are plain dicts; batches convert
+to "numpy" (dict of ndarrays), "pandas", or "pyarrow" on request.
+"""
+
+from typing import Any, Dict, Iterable, Iterator, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+VALUE_COL = "value"  # single-column datasets (from_items on scalars, range)
+
+
+def block_from_rows(rows: List[Dict[str, Any]]) -> pa.Table:
+    if not rows:
+        return pa.table({})
+    if not isinstance(rows[0], dict):
+        rows = [{VALUE_COL: r} for r in rows]
+    cols: Dict[str, List] = {k: [] for k in rows[0]}
+    for r in rows:
+        if not isinstance(r, dict):
+            r = {VALUE_COL: r}
+        for k in cols:
+            cols[k].append(r.get(k))
+    return block_from_numpy_dict({k: v for k, v in cols.items()})
+
+
+def block_from_numpy_dict(data: Dict[str, Any]) -> pa.Table:
+    arrays, names = [], []
+    for k, v in data.items():
+        names.append(k)
+        v = np.asarray(v) if not isinstance(v, (pa.Array, pa.ChunkedArray, list)) else v
+        if isinstance(v, np.ndarray) and v.ndim > 1:
+            # tensor column: store as fixed-size lists (arrow-native layout)
+            flat = v.reshape(len(v), -1)
+            arrays.append(pa.FixedSizeListArray.from_arrays(
+                pa.array(flat.ravel()), flat.shape[1]))
+        else:
+            arrays.append(pa.array(v))
+    return pa.table(dict(zip(names, arrays)))
+
+
+def block_num_rows(block: pa.Table) -> int:
+    return block.num_rows
+
+
+def block_to_rows(block: pa.Table) -> Iterator[Dict[str, Any]]:
+    cols = {name: _column_to_numpy(block, name) for name in block.column_names}
+    if len(cols) == 1 and VALUE_COL in cols:
+        vals = cols[VALUE_COL]
+        for i in range(block.num_rows):
+            yield {VALUE_COL: vals[i]}
+    else:
+        for i in range(block.num_rows):
+            yield {k: v[i] for k, v in cols.items()}
+
+
+def _column_to_numpy(block: pa.Table, name: str) -> np.ndarray:
+    col = block.column(name)
+    typ = col.type
+    if pa.types.is_fixed_size_list(typ):
+        width = typ.list_size
+        flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+        return flat.reshape(-1, width)
+    try:
+        return col.to_numpy(zero_copy_only=False)
+    except pa.ArrowInvalid:
+        return np.asarray(col.to_pylist(), dtype=object)
+
+
+def block_to_format(block: pa.Table, batch_format: str):
+    if batch_format in ("pyarrow", "arrow"):
+        return block
+    if batch_format == "pandas":
+        return block.to_pandas()
+    if batch_format in ("numpy", "default", None):
+        return {name: _column_to_numpy(block, name)
+                for name in block.column_names}
+    raise ValueError(f"unknown batch_format {batch_format!r}")
+
+
+def block_from_format(batch, source_format_hint: Optional[str] = None) -> pa.Table:
+    if isinstance(batch, pa.Table):
+        return batch
+    if isinstance(batch, dict):
+        return block_from_numpy_dict(batch)
+    try:
+        import pandas as pd
+        if isinstance(batch, pd.DataFrame):
+            return pa.Table.from_pandas(batch, preserve_index=False)
+    except ImportError:
+        pass
+    if isinstance(batch, list):
+        return block_from_rows(batch)
+    if isinstance(batch, np.ndarray):
+        return block_from_numpy_dict({VALUE_COL: batch})
+    raise TypeError(f"can't build a block from {type(batch)}")
+
+
+def block_slice(block: pa.Table, start: int, end: int) -> pa.Table:
+    return block.slice(start, end - start)
+
+
+def block_concat(blocks: List[pa.Table]) -> pa.Table:
+    blocks = [b for b in blocks if b.num_rows > 0] or blocks[:1]
+    if not blocks:
+        return pa.table({})
+    return pa.concat_tables(blocks, promote_options="permissive")
+
+
+def block_select(block: pa.Table, columns: List[str]) -> pa.Table:
+    return block.select(columns)
+
+
+def block_sort(block: pa.Table, key, descending: bool = False) -> pa.Table:
+    keys = [key] if isinstance(key, str) else list(key)
+    order = "descending" if descending else "ascending"
+    return block.sort_by([(k, order) for k in keys])
+
+
+def split_block_rows(block: pa.Table, target_rows: int) -> List[pa.Table]:
+    if block.num_rows <= target_rows:
+        return [block]
+    return [block.slice(i, target_rows)
+            for i in range(0, block.num_rows, target_rows)]

@@ -1,0 +1,349 @@
+"""Dataset (reference: python/ray/data/dataset.py).
+
+Lazy, immutable: every transform returns a new Dataset with one more plan op.
+Nothing runs until consumption (take/count/iter_*/write_*/materialize).
+"""
+
+import itertools
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple, Union
+
+import numpy as np
+import pyarrow as pa
+
+from . import block as B
+from .plan import AllToAllOp, BlockOp, Plan, Source
+
+
+class Dataset:
+    def __init__(self, plan: Plan):
+        self._plan = plan
+
+    # ------------------------------------------------------------ transforms
+    def _block_op(self, name: str, fn) -> "Dataset":
+        return Dataset(self._plan.with_op(BlockOp(name, fn)))
+
+    def map(self, fn: Callable[[Dict], Dict]) -> "Dataset":
+        def _map(block):
+            return B.block_from_rows([fn(r) for r in B.block_to_rows(block)])
+        return self._block_op("map", _map)
+
+    def map_batches(self, fn, *, batch_format: str = "numpy",
+                    batch_size: Optional[int] = None, **_compat) -> "Dataset":
+        def _mb(block):
+            outs = []
+            sub_blocks = (B.split_block_rows(block, batch_size)
+                          if batch_size else [block])
+            for sb in sub_blocks:
+                out = fn(B.block_to_format(sb, batch_format))
+                outs.append(B.block_from_format(out))
+            return B.block_concat(outs)
+        return self._block_op("map_batches", _mb)
+
+    def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
+        def _fm(block):
+            rows = []
+            for r in B.block_to_rows(block):
+                rows.extend(fn(r))
+            return B.block_from_rows(rows)
+        return self._block_op("flat_map", _fm)
+
+    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+        def _fl(block):
+            keep = [i for i, r in enumerate(B.block_to_rows(block)) if fn(r)]
+            return block.take(keep) if keep else block.slice(0, 0)
+        return self._block_op("filter", _fl)
+
+    def add_column(self, name: str, fn) -> "Dataset":
+        def _ac(block):
+            batch = B.block_to_format(block, "pandas")
+            col = fn(batch)
+            return B.block_from_format(batch.assign(**{name: col}))
+        return self._block_op("add_column", _ac)
+
+    def drop_columns(self, cols: List[str]) -> "Dataset":
+        def _dc(block):
+            keep = [c for c in block.column_names if c not in cols]
+            return block.select(keep)
+        return self._block_op("drop_columns", _dc)
+
+    def select_columns(self, cols: List[str]) -> "Dataset":
+        return self._block_op("select_columns", lambda b: b.select(cols))
+
+    def rename_columns(self, mapping: Dict[str, str]) -> "Dataset":
+        def _rn(block):
+            return block.rename_columns(
+                [mapping.get(c, c) for c in block.column_names])
+        return self._block_op("rename_columns", _rn)
+
+    def limit(self, n: int) -> "Dataset":
+        def _lim(blocks):
+            out, left = [], n
+            for b in blocks:
+                if left <= 0:
+                    break
+                take = min(left, b.num_rows)
+                out.append(b.slice(0, take))
+                left -= take
+            return out
+        return Dataset(self._plan.with_op(AllToAllOp("limit", _lim)))
+
+    def union(self, *others: "Dataset") -> "Dataset":
+        all_blocks = self.to_block_list()
+        for o in others:
+            all_blocks += o.to_block_list()
+        return from_blocks(all_blocks)
+
+    def zip(self, other: "Dataset") -> "Dataset":
+        left = B.block_concat(self.to_block_list())
+        right = B.block_concat(other.to_block_list())
+        if left.num_rows != right.num_rows:
+            raise ValueError(
+                f"zip requires equal row counts ({left.num_rows} vs "
+                f"{right.num_rows})")
+        cols = {c: left.column(c) for c in left.column_names}
+        for c in right.column_names:
+            name = f"{c}_1" if c in cols else c
+            cols[name] = right.column(c)
+        return from_blocks([pa.table(cols)])
+
+    # -------------------------------------------------------------- shuffles
+    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
+        def _sh(blocks):
+            whole = B.block_concat(blocks)
+            rng = np.random.default_rng(seed)
+            perm = rng.permutation(whole.num_rows)
+            shuffled = whole.take(pa.array(perm))
+            target = max(whole.num_rows // max(len(blocks), 1), 1)
+            return B.split_block_rows(shuffled, target)
+        return Dataset(self._plan.with_op(AllToAllOp("random_shuffle", _sh)))
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        def _rp(blocks):
+            whole = B.block_concat(blocks)
+            n = whole.num_rows
+            if n == 0:
+                return [whole]
+            per = -(-n // num_blocks)
+            return [whole.slice(i * per, min(per, n - i * per))
+                    for i in range(num_blocks) if i * per < n]
+        return Dataset(self._plan.with_op(AllToAllOp("repartition", _rp)))
+
+    def sort(self, key: Union[str, List[str]],
+             descending: bool = False) -> "Dataset":
+        def _srt(blocks):
+            whole = B.block_concat(blocks)
+            out = B.block_sort(whole, key, descending)
+            target = max(out.num_rows // max(len(blocks), 1), 1)
+            return B.split_block_rows(out, target)
+        return Dataset(self._plan.with_op(AllToAllOp("sort", _srt)))
+
+    def groupby(self, key: str) -> "GroupedData":
+        return GroupedData(self, key)
+
+    # ---------------------------------------------------------------- splits
+    def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
+        whole = B.block_concat(self.to_block_list())
+        total = whole.num_rows
+        per = total // n if equal else -(-total // n)
+        out = []
+        for i in range(n):
+            start = i * per
+            end = min(start + per, total) if not equal else start + per
+            out.append(from_blocks([whole.slice(start, max(end - start, 0))]))
+        return out
+
+    def split_at_indices(self, indices: List[int]) -> List["Dataset"]:
+        whole = B.block_concat(self.to_block_list())
+        bounds = [0] + list(indices) + [whole.num_rows]
+        return [from_blocks([whole.slice(a, b - a)])
+                for a, b in zip(bounds[:-1], bounds[1:])]
+
+    def train_test_split(self, test_size: float, *, shuffle: bool = False,
+                         seed: Optional[int] = None) -> Tuple["Dataset", "Dataset"]:
+        ds = self.random_shuffle(seed=seed) if shuffle else self
+        whole = B.block_concat(ds.to_block_list())
+        n_test = int(whole.num_rows * test_size)
+        split = whole.num_rows - n_test
+        return (from_blocks([whole.slice(0, split)]),
+                from_blocks([whole.slice(split)]))
+
+    # ----------------------------------------------------------- consumption
+    def to_block_list(self) -> List[pa.Table]:
+        return self._plan.execute()
+
+    def materialize(self) -> "Dataset":
+        return from_blocks(self.to_block_list())
+
+    def iter_rows(self) -> Iterator[Dict]:
+        for blk in self._plan.iter_blocks():
+            yield from B.block_to_rows(blk)
+
+    def take(self, n: int = 20) -> List[Dict]:
+        return list(itertools.islice(self.iter_rows(), n))
+
+    def take_all(self) -> List[Dict]:
+        return list(self.iter_rows())
+
+    def take_batch(self, n: int = 20, *, batch_format: str = "numpy"):
+        blocks, got = [], 0
+        for blk in self._plan.iter_blocks():
+            blocks.append(blk)
+            got += blk.num_rows
+            if got >= n:
+                break
+        whole = B.block_concat(blocks).slice(0, n)
+        return B.block_to_format(whole, batch_format)
+
+    def count(self) -> int:
+        return sum(b.num_rows for b in self._plan.iter_blocks())
+
+    def schema(self):
+        for blk in self._plan.iter_blocks():
+            return blk.schema
+        return None
+
+    def columns(self) -> List[str]:
+        s = self.schema()
+        return list(s.names) if s else []
+
+    def num_blocks(self) -> int:
+        return len(self.to_block_list())
+
+    def show(self, n: int = 20) -> None:
+        for row in self.take(n):
+            print(row)
+
+    def stats(self) -> str:
+        return self._plan.stats.summary()
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy",
+                     prefetch_batches: int = 1,
+                     drop_last: bool = False) -> Iterator:
+        def gen():
+            carry: List[pa.Table] = []
+            carried = 0
+            for blk in self._plan.iter_blocks():
+                carry.append(blk)
+                carried += blk.num_rows
+                while carried >= batch_size:
+                    whole = B.block_concat(carry)
+                    batch = whole.slice(0, batch_size)
+                    rest = whole.slice(batch_size)
+                    carry, carried = [rest], rest.num_rows
+                    yield B.block_to_format(batch, batch_format)
+            if carried and not drop_last:
+                yield B.block_to_format(B.block_concat(carry), batch_format)
+
+        if prefetch_batches > 0:
+            from ray_tpu.train.ingest import prefetch_iterator
+            return prefetch_iterator(gen(), depth=prefetch_batches + 1)
+        return gen()
+
+    def iter_device_batches(self, *, batch_size: int = 256, sharding=None,
+                            prefetch: int = 2, drop_last: bool = True):
+        """Batches as device arrays, double-buffered host→HBM (the TPU input
+        pipeline; reference: iter_torch_batches)."""
+        from ray_tpu.train.ingest import iter_device_batches as _idb
+        host = self.iter_batches(batch_size=batch_size, batch_format="numpy",
+                                 prefetch_batches=0, drop_last=drop_last)
+        return _idb(host, sharding=sharding, prefetch=prefetch)
+
+    # ---------------------------------------------------------------- writes
+    def write_parquet(self, path: str) -> None:
+        self._write(path, "parquet")
+
+    def write_csv(self, path: str) -> None:
+        self._write(path, "csv")
+
+    def write_json(self, path: str) -> None:
+        self._write(path, "json")
+
+    def _write(self, path: str, fmt: str) -> None:
+        import os
+        os.makedirs(path, exist_ok=True)
+        for i, blk in enumerate(self._plan.iter_blocks()):
+            fp = os.path.join(path, f"part-{i:05d}.{fmt}")
+            if fmt == "parquet":
+                import pyarrow.parquet as pq
+                pq.write_table(blk, fp)
+            elif fmt == "csv":
+                import pyarrow.csv as pcsv
+                pcsv.write_csv(blk, fp)
+            else:
+                blk.to_pandas().to_json(fp, orient="records", lines=True)
+
+    def __repr__(self):
+        return f"Dataset(ops={[type(o).__name__ for o in self._plan.ops]})"
+
+
+class GroupedData:
+    """groupby().agg (reference: ray.data.grouped_data.GroupedData)."""
+
+    _AGGS = {"count": "count", "sum": "sum", "mean": "mean", "min": "min",
+             "max": "max", "std": "std"}
+
+    def __init__(self, ds: Dataset, key: str):
+        self._ds = ds
+        self._key = key
+
+    def _agg(self, how: str, on: Optional[str] = None) -> Dataset:
+        key = self._key
+
+        def _g(blocks):
+            import pandas as pd
+            df = B.block_concat(blocks).to_pandas()
+            g = df.groupby(key, sort=True)
+            if how == "count":
+                out = g.size().reset_index(name="count()")
+            else:
+                cols = [on] if on else [c for c in df.columns if c != key]
+                out = getattr(g[cols], how)().reset_index()
+                out.columns = [key] + [f"{how}({c})" for c in cols]
+            return [pa.Table.from_pandas(out, preserve_index=False)]
+
+        return Dataset(self._ds._plan.with_op(AllToAllOp(f"groupby.{how}", _g)))
+
+    def count(self) -> Dataset:
+        return self._agg("count")
+
+    def sum(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("sum", on)
+
+    def mean(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("mean", on)
+
+    def min(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("min", on)
+
+    def max(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("max", on)
+
+    def std(self, on: Optional[str] = None) -> Dataset:
+        return self._agg("std", on)
+
+    def aggregate(self, *aggs) -> Dataset:
+        """aggs: ("sum", col) tuples or names from _AGGS."""
+        key = self._key
+
+        def _g(blocks):
+            import pandas as pd
+            df = B.block_concat(blocks).to_pandas()
+            g = df.groupby(key, sort=True)
+            pieces = []
+            for agg in aggs:
+                how, on = agg if isinstance(agg, tuple) else (agg, None)
+                if how == "count":
+                    pieces.append(g.size().rename("count()"))
+                else:
+                    col = on or [c for c in df.columns if c != key][0]
+                    pieces.append(getattr(g[col], how)().rename(f"{how}({col})"))
+            out = pd.concat(pieces, axis=1).reset_index()
+            return [pa.Table.from_pandas(out, preserve_index=False)]
+
+        return Dataset(self._ds._plan.with_op(AllToAllOp("groupby.agg", _g)))
+
+
+def from_blocks(blocks: List[pa.Table]) -> Dataset:
+    return Dataset(Plan(Source([(lambda b=b: b) for b in blocks],
+                               name="from_blocks")))

@@ -1,0 +1,148 @@
+"""Creation + file readers (reference: python/ray/data/read_api.py).
+
+One block per input file (or per slice of an in-memory source). Readers are
+thunks in the plan source, so files are opened inside data tasks — lazily and
+in parallel — not at read_* call time.
+"""
+
+import glob as _glob
+import os
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from . import block as B
+from .dataset import Dataset, from_blocks
+from .plan import Plan, Source
+
+DEFAULT_NUM_BLOCKS = 8
+
+
+import builtins
+
+
+def _slice_bounds(n: int, k: int):
+    per = -(-n // k) if n else 1
+    # builtins.range: the module-level `range` below shadows it (API parity
+    # with ray.data.range)
+    return [(i, min(i + per, n))
+            for i in builtins.range(0, n, per)] or [(0, 0)]
+
+
+def from_items(items: List[Any], *, override_num_blocks: Optional[int] = None) -> Dataset:
+    k = min(override_num_blocks or DEFAULT_NUM_BLOCKS, max(len(items), 1))
+    blocks = [B.block_from_rows(items[a:b])
+              for a, b in _slice_bounds(len(items), k)]
+    return from_blocks(blocks)
+
+
+def range(n: int, *, override_num_blocks: Optional[int] = None) -> Dataset:  # noqa: A001
+    k = min(override_num_blocks or DEFAULT_NUM_BLOCKS, max(n, 1))
+    blocks = [B.block_from_numpy_dict({"id": np.arange(a, b)})
+              for a, b in _slice_bounds(n, k)]
+    return from_blocks(blocks)
+
+
+def from_numpy(arr: np.ndarray, *, column: str = "data",
+               override_num_blocks: Optional[int] = None) -> Dataset:
+    k = min(override_num_blocks or DEFAULT_NUM_BLOCKS, max(len(arr), 1))
+    blocks = [B.block_from_numpy_dict({column: arr[a:b]})
+              for a, b in _slice_bounds(len(arr), k)]
+    return from_blocks(blocks)
+
+
+def from_pandas(df) -> Dataset:
+    import pandas as pd
+    dfs = df if isinstance(df, list) else [df]
+    return from_blocks([pa.Table.from_pandas(d, preserve_index=False)
+                        for d in dfs])
+
+
+def from_arrow(tables) -> Dataset:
+    tables = tables if isinstance(tables, list) else [tables]
+    return from_blocks(list(tables))
+
+
+def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            inner = sorted(_glob.glob(os.path.join(p, "*")))
+            out.extend(f for f in inner
+                       if suffix is None or f.endswith(suffix))
+        elif "*" in p:
+            out.extend(sorted(_glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no files matched {paths}")
+    return out
+
+
+def read_parquet(paths, **_compat) -> Dataset:
+    files = _expand_paths(paths, ".parquet")
+
+    def reader(fp):
+        def thunk():
+            import pyarrow.parquet as pq
+            return pq.read_table(fp)
+        return thunk
+
+    return Dataset(Plan(Source([reader(f) for f in files], "read_parquet")))
+
+
+def read_csv(paths, **_compat) -> Dataset:
+    files = _expand_paths(paths)
+
+    def reader(fp):
+        def thunk():
+            import pyarrow.csv as pcsv
+            return pcsv.read_csv(fp)
+        return thunk
+
+    return Dataset(Plan(Source([reader(f) for f in files], "read_csv")))
+
+
+def read_json(paths, **_compat) -> Dataset:
+    files = _expand_paths(paths)
+
+    def reader(fp):
+        def thunk():
+            import pyarrow.json as pjson
+            return pjson.read_json(fp)
+        return thunk
+
+    return Dataset(Plan(Source([reader(f) for f in files], "read_json")))
+
+
+def read_text(paths, **_compat) -> Dataset:
+    files = _expand_paths(paths)
+
+    def reader(fp):
+        def thunk():
+            with open(fp, "r") as f:
+                lines = [ln.rstrip("\n") for ln in f]
+            return B.block_from_numpy_dict({"text": np.asarray(lines, object)})
+        return thunk
+
+    return Dataset(Plan(Source([reader(f) for f in files], "read_text")))
+
+
+def read_binary_files(paths, *, include_paths: bool = False, **_compat) -> Dataset:
+    files = _expand_paths(paths)
+
+    def reader(fp):
+        def thunk():
+            with open(fp, "rb") as f:
+                data = f.read()
+            cols: Dict[str, Any] = {"bytes": pa.array([data], pa.binary())}
+            if include_paths:
+                cols["path"] = pa.array([fp])
+            return pa.table(cols)
+        return thunk
+
+    return Dataset(Plan(Source([reader(f) for f in files],
+                               "read_binary_files")))

@@ -1,0 +1,178 @@
+"""Lazy plan + streaming executor (reference: python/ray/data/_internal/
+logical_plan.py + execution/streaming_executor.py).
+
+A plan is a source (block thunks) plus a list of ops. Per-block ops fuse into
+one callable per block; fused stages run as ray_tpu tasks when the runtime is
+up (CPU parallelism across blocks — the reference's map-task model), inline
+otherwise. All-to-all ops (shuffle/sort/repartition/groupby) materialize at
+their barrier, stream after. Per-op wall time is recorded for `ds.stats()`.
+"""
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Iterator, List, Optional
+
+import pyarrow as pa
+
+from . import block as B
+
+# Max fused-stage tasks in flight (bounds memory like the reference's
+# streaming executor backpressure).
+_MAX_INFLIGHT = 8
+
+
+@dataclass
+class BlockOp:
+    """Per-block transform (fusable)."""
+    name: str
+    fn: Callable[[pa.Table], pa.Table]
+
+
+@dataclass
+class AllToAllOp:
+    """Barrier transform over the full block list."""
+    name: str
+    fn: Callable[[List[pa.Table]], List[pa.Table]]
+
+
+@dataclass
+class Source:
+    """Block producers: zero-arg thunks (file readers, in-memory tables)."""
+    thunks: List[Callable[[], pa.Table]]
+    name: str = "source"
+
+
+@dataclass
+class Stats:
+    op_time_s: Dict[str, float] = field(default_factory=dict)
+    op_rows: Dict[str, int] = field(default_factory=dict)
+
+    def add(self, name: str, dt: float, rows: int):
+        self.op_time_s[name] = self.op_time_s.get(name, 0.0) + dt
+        self.op_rows[name] = self.op_rows.get(name, 0) + rows
+
+    def summary(self) -> str:
+        lines = ["Op           rows      time"]
+        for name, t in self.op_time_s.items():
+            lines.append(f"{name:<12} {self.op_rows.get(name, 0):<9} {t:.3f}s")
+        return "\n".join(lines)
+
+
+class Plan:
+    def __init__(self, source: Source, ops: Optional[List] = None):
+        self.source = source
+        self.ops = ops or []
+        self.stats = Stats()
+
+    def with_op(self, op) -> "Plan":
+        return Plan(self.source, self.ops + [op])
+
+    # -- execution -----------------------------------------------------------
+    def _stages(self) -> List:
+        """Group ops into [fused BlockOps] | AllToAllOp | ... preserving order."""
+        stages: List = []
+        fuse: List[BlockOp] = []
+        for op in self.ops:
+            if isinstance(op, BlockOp):
+                fuse.append(op)
+            else:
+                if fuse:
+                    stages.append(list(fuse))
+                    fuse = []
+                stages.append(op)
+        if fuse:
+            stages.append(list(fuse))
+        return stages
+
+    def iter_blocks(self) -> Iterator[pa.Table]:
+        """Stream blocks through the plan (the streaming executor)."""
+        stats = self.stats
+
+        def apply_fused(ops: List[BlockOp], blocks: Iterator[pa.Table]):
+            fn = _fuse(ops)
+            names = "+".join(o.name for o in ops)
+            use_tasks = _runtime_up()
+            if use_tasks:
+                yield from _map_tasks(fn, blocks, names, stats)
+            else:
+                for blk in blocks:
+                    t0 = time.perf_counter()
+                    out = fn(blk)
+                    stats.add(names, time.perf_counter() - t0, out.num_rows)
+                    yield out
+
+        def source_blocks():
+            use_tasks = _runtime_up() and len(self.source.thunks) > 1
+            if use_tasks:
+                yield from _map_tasks(lambda thunk: thunk(),
+                                      iter(self.source.thunks),
+                                      self.source.name, stats)
+            else:
+                for thunk in self.source.thunks:
+                    t0 = time.perf_counter()
+                    blk = thunk()
+                    stats.add(self.source.name, time.perf_counter() - t0,
+                              blk.num_rows)
+                    yield blk
+
+        blocks: Iterator[pa.Table] = source_blocks()
+        for stage in self._stages():
+            if isinstance(stage, list):
+                blocks = apply_fused(stage, blocks)
+            else:  # AllToAllOp barrier
+                blocks = _barrier(stage, blocks, stats)
+        return blocks
+
+    def execute(self) -> List[pa.Table]:
+        return list(self.iter_blocks())
+
+
+def _fuse(ops: List[BlockOp]) -> Callable[[pa.Table], pa.Table]:
+    fns = [o.fn for o in ops]
+
+    def fused(block: pa.Table) -> pa.Table:
+        for f in fns:
+            block = f(block)
+        return block
+
+    return fused
+
+
+def _barrier(op: AllToAllOp, blocks: Iterator[pa.Table], stats: Stats):
+    mat = list(blocks)
+    t0 = time.perf_counter()
+    out = op.fn(mat)
+    stats.add(op.name, time.perf_counter() - t0,
+              sum(b.num_rows for b in out))
+    yield from out
+
+
+def _runtime_up() -> bool:
+    try:
+        import ray_tpu
+        return ray_tpu.is_initialized()
+    except Exception:  # noqa: BLE001
+        return False
+
+
+def _map_tasks(fn, items: Iterator, name: str, stats: Stats):
+    """Windowed task fan-out preserving order (streaming backpressure)."""
+    import collections
+
+    import ray_tpu
+
+    remote_fn = ray_tpu.remote(**{"num_cpus": 1, "name": f"data::{name}"})(fn)
+    pending = collections.deque()
+    t0 = time.perf_counter()
+    rows = 0
+    for item in items:
+        pending.append(remote_fn.remote(item))
+        if len(pending) >= _MAX_INFLIGHT:
+            blk = ray_tpu.get(pending.popleft())
+            rows += blk.num_rows
+            yield blk
+    while pending:
+        blk = ray_tpu.get(pending.popleft())
+        rows += blk.num_rows
+        yield blk
+    stats.add(name, time.perf_counter() - t0, rows)

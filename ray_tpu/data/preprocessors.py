@@ -1,0 +1,160 @@
+"""Preprocessors (reference: python/ray/data/preprocessors/*).
+
+fit() computes stats over a Dataset; transform() is a map_batches. All stats
+are plain dicts so fitted preprocessors pickle into train workers.
+"""
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .dataset import Dataset
+
+
+class Preprocessor:
+    _fitted = False
+
+    def fit(self, ds: Dataset) -> "Preprocessor":
+        self._fit(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        if not self._fitted and self._needs_fit():
+            raise RuntimeError(f"{type(self).__name__} must be fit() first")
+        return ds.map_batches(self._transform_numpy, batch_format="numpy")
+
+    def fit_transform(self, ds: Dataset) -> Dataset:
+        return self.fit(ds).transform(ds)
+
+    def _needs_fit(self) -> bool:
+        return True
+
+    def _fit(self, ds: Dataset) -> None:
+        raise NotImplementedError
+
+    def _transform_numpy(self, batch: Dict[str, np.ndarray]):
+        raise NotImplementedError
+
+
+class BatchMapper(Preprocessor):
+    """Stateless fn over batches (reference: BatchMapper)."""
+
+    def __init__(self, fn: Callable, batch_format: str = "numpy"):
+        self.fn = fn
+        self.batch_format = batch_format
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def transform(self, ds: Dataset) -> Dataset:
+        return ds.map_batches(self.fn, batch_format=self.batch_format)
+
+
+class StandardScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        acc = {c: [0.0, 0.0, 0] for c in self.columns}  # sum, sumsq, n
+        for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
+            for c in self.columns:
+                v = batch[c].astype(np.float64)
+                acc[c][0] += v.sum()
+                acc[c][1] += np.square(v).sum()
+                acc[c][2] += v.size
+        for c, (s, ss, n) in acc.items():
+            mean = s / max(n, 1)
+            var = max(ss / max(n, 1) - mean * mean, 0.0)
+            self.stats_[c] = (mean, float(np.sqrt(var)) or 1.0)
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c, (mean, std) in self.stats_.items():
+            out[c] = (batch[c] - mean) / (std if std > 0 else 1.0)
+        return out
+
+
+class MinMaxScaler(Preprocessor):
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.stats_: Dict[str, tuple] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        lo = {c: np.inf for c in self.columns}
+        hi = {c: -np.inf for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
+            for c in self.columns:
+                lo[c] = min(lo[c], float(batch[c].min()))
+                hi[c] = max(hi[c], float(batch[c].max()))
+        self.stats_ = {c: (lo[c], hi[c]) for c in self.columns}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c, (lo, hi) in self.stats_.items():
+            span = (hi - lo) or 1.0
+            out[c] = (batch[c] - lo) / span
+        return out
+
+
+class LabelEncoder(Preprocessor):
+    def __init__(self, label_column: str):
+        self.label_column = label_column
+        self.classes_: Optional[np.ndarray] = None
+
+    def _fit(self, ds: Dataset) -> None:
+        seen = set()
+        for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
+            seen.update(np.asarray(batch[self.label_column]).tolist())
+        self.classes_ = np.array(sorted(seen))
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        lookup = {v: i for i, v in enumerate(self.classes_.tolist())}
+        out[self.label_column] = np.array(
+            [lookup[v] for v in np.asarray(batch[self.label_column]).tolist()])
+        return out
+
+
+class Concatenator(Preprocessor):
+    """Merge feature columns into one matrix column (the TPU-friendly layout:
+    one dense [B, F] array feeds the device without per-column gathers)."""
+
+    def __init__(self, columns: List[str], output_column_name: str = "concat_out",
+                 dtype=np.float32):
+        self.columns = columns
+        self.output_column_name = output_column_name
+        self.dtype = dtype
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def _transform_numpy(self, batch):
+        mats = [np.asarray(batch[c]).reshape(len(batch[c]), -1)
+                for c in self.columns]
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        out[self.output_column_name] = np.concatenate(mats, 1).astype(self.dtype)
+        return out
+
+
+class Chain(Preprocessor):
+    def __init__(self, *preprocessors: Preprocessor):
+        self.preprocessors = preprocessors
+
+    def fit(self, ds: Dataset) -> "Chain":
+        for p in self.preprocessors:
+            ds = p.fit_transform(ds)
+        self._fitted = True
+        return self
+
+    def transform(self, ds: Dataset) -> Dataset:
+        for p in self.preprocessors:
+            ds = p.transform(ds)
+        return ds

@@ -70,10 +70,9 @@ def shutdown() -> None:
         return
     try:
         ctrl = get_controller()
-        import ray_tpu as rt
-        for app in ("default",):
-            rt.get(ctrl.delete_app.remote(app))
-        rt.kill(ctrl)
+        for app in ray_tpu.get(ctrl.list_apps.remote()):
+            ray_tpu.get(ctrl.delete_app.remote(app))
+        ray_tpu.kill(ctrl)
     except Exception:  # noqa: BLE001
         pass
 
@@ -82,7 +81,7 @@ def status() -> Dict:
     import ray_tpu
     ctrl = get_controller()
     out = {}
-    for app in ("default",):
+    for app in ray_tpu.get(ctrl.list_apps.remote()):
         for dep in ray_tpu.get(ctrl.list_deployments.remote(app)):
             out[f"{app}:{dep}"] = {
                 "replicas": ray_tpu.get(ctrl.num_replicas.remote(app, dep))}

@@ -27,24 +27,30 @@ class _Batcher:
         async with self._lock:
             self.queue.append((item, fut))
             if len(self.queue) >= self.max_batch_size:
-                await self._flush(owner)
+                self._launch_flush(loop, owner)
             elif self._flush_handle is None:
                 self._flush_handle = loop.call_later(
                     self.timeout_s,
                     lambda: loop.create_task(self._flush_locked(owner)))
         return await fut
 
-    async def _flush_locked(self, owner):
-        async with self._lock:
-            await self._flush(owner)
-
-    async def _flush(self, owner):
+    def _launch_flush(self, loop, owner):
+        """Pop the queue NOW (caller holds the lock or runs on the loop) and
+        run the batch fn in a separate task — never while holding the lock,
+        so the next batch keeps filling during a slow batch execution."""
         if self._flush_handle is not None:
             self._flush_handle.cancel()
             self._flush_handle = None
         if not self.queue:
             return
         batch, self.queue = self.queue, []
+        loop.create_task(self._run_batch(owner, batch))
+
+    async def _flush_locked(self, owner):
+        async with self._lock:
+            self._launch_flush(asyncio.get_running_loop(), owner)
+
+    async def _run_batch(self, owner, batch):
         items = [b[0] for b in batch]
         futs = [b[1] for b in batch]
         try:

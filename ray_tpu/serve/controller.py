@@ -21,12 +21,22 @@ class ServeController:
     # -- registry ------------------------------------------------------------
     def register_deployment(self, app: str, name: str, blob, init_args,
                             init_kwargs, config) -> None:
+        existing = self.apps.get(app, {}).get(name)
+        if existing is not None:
+            # redeploy: retire old replicas first (their actor names would
+            # collide, and dropping the handles would leak the processes)
+            self._scale_to(app, name, 0)
+        version = existing["version"] + 1 if existing else 0
         self.apps.setdefault(app, {})[name] = {
             "replicas": [], "config": config, "blob": blob,
-            "init": (init_args, init_kwargs), "version": 0,
+            "init": (init_args, init_kwargs), "version": version,
+            "next_idx": existing["next_idx"] if existing else 0,
             "last_scale_ts": 0.0,
         }
         self._scale_to(app, name, config.num_replicas)
+
+    def list_apps(self) -> List[str]:
+        return list(self.apps)
 
     def delete_app(self, app: str) -> None:
         import ray_tpu
@@ -51,6 +61,8 @@ class ServeController:
         return len(self.apps[app][name]["replicas"])
 
     # -- scaling -------------------------------------------------------------
+    _DRAIN_TIMEOUT_S = 3.0
+
     def _scale_to(self, app: str, name: str, target: int) -> None:
         import ray_tpu
         from .replica import Replica
@@ -59,7 +71,10 @@ class ServeController:
         cfg = rec["config"]
         replicas = rec["replicas"]
         while len(replicas) < target:
-            idx = len(replicas)
+            # monotonic replica index: names never collide with ones being
+            # torn down (redeploy) or previously downscaled
+            idx = rec.setdefault("next_idx", len(replicas))
+            rec["next_idx"] = idx + 1
             opts = dict(cfg.ray_actor_options or {})
             opts.setdefault("max_concurrency", cfg.max_ongoing_requests)
             opts["name"] = f"SERVE::{app}::{name}#{idx}"
@@ -67,12 +82,27 @@ class ServeController:
             args, kwargs = rec["init"]
             replicas.append(actor_cls.remote(rec["blob"], args, kwargs,
                                              cfg.user_config))
+        doomed = []
         while len(replicas) > target:
-            h = replicas.pop()
-            try:
-                ray_tpu.kill(h)
-            except Exception:  # noqa: BLE001
-                pass
+            doomed.append(replicas.pop())
+        if doomed:
+            # bump version FIRST so handles re-route before the kill lands,
+            # then drain best-effort before killing
+            rec["version"] += 1
+            deadline = time.time() + self._DRAIN_TIMEOUT_S
+            for h in doomed:
+                while time.time() < deadline:
+                    try:
+                        if ray_tpu.get(h.stats.remote(),
+                                       timeout=1)["ongoing"] == 0:
+                            break
+                    except Exception:  # noqa: BLE001 - already dead
+                        break
+                    time.sleep(0.05)
+                try:
+                    ray_tpu.kill(h)
+                except Exception:  # noqa: BLE001
+                    pass
         rec["version"] += 1
         rec["last_scale_ts"] = time.time()
 
@@ -119,9 +149,9 @@ class ServeController:
 
 def decide_num_replicas(total_ongoing: float, current: int, auto) -> int:
     """Pure autoscaling decision (unit-testable): scale toward
-    total_ongoing / target, clamped to [min_replicas, max_replicas]."""
-    if current == 0:
-        return max(auto.min_replicas, 1)
+    total_ongoing / target, clamped to [min_replicas, max_replicas].
+    No special bootstrap branch: with min_replicas=0 and no demand the
+    answer stays 0 (a forced floor of 1 would flap 0↔1 every interval)."""
     desired = math.ceil(total_ongoing / max(auto.target_ongoing_requests, 1e-9))
     return int(min(max(desired, auto.min_replicas), auto.max_replicas))
 

@@ -67,7 +67,9 @@ class DeploymentHandle:
                              self._stream if stream is None else stream)
         h._replicas = self._replicas
         h._inflight = self._inflight
+        h._lock = self._lock  # shared counters need the shared lock
         h._version = self._version
+        h._last_refresh = self._last_refresh
         return h
 
     # bound per-request controller chatter; scale-ups are picked up within

@@ -42,6 +42,7 @@ class _Slot:
     done_event: asyncio.Event
     stream_queue: Optional[asyncio.Queue] = None
     eos_id: Optional[int] = None
+    error: Optional[BaseException] = None
 
 
 class LLMServer:
@@ -124,14 +125,14 @@ class LLMServer:
                                 static_argnums=())
         self._decode = jax.jit(decode_step, donate_argnums=(1,))
 
-    @staticmethod
-    def _bucket(n: int) -> int:
+    def _bucket(self, n: int) -> int:
         """Pad prompt lengths to power-of-two buckets: few compiled prefill
-        variants instead of one per length."""
+        variants instead of one per length. Clamped to the cache row size —
+        a larger padded write would violate KVCache's capacity invariant."""
         b = 16
         while b < n:
             b *= 2
-        return b
+        return min(b, self.config.max_seq_len)
 
     # -- request admission ---------------------------------------------------
     async def _admit(self, prompt_ids: List[int], max_tokens: int,
@@ -171,6 +172,19 @@ class LLMServer:
                 self._tick_loop())
 
     async def _tick_loop(self):
+        try:
+            await self._tick_loop_inner()
+        except BaseException as e:  # noqa: BLE001 - fail every waiter loudly
+            for i, slot in list(self._active.items()):
+                slot.error = e
+                slot.done_event.set()
+                if slot.stream_queue is not None:
+                    slot.stream_queue.put_nowait(None)
+                self._free.append(i)
+            self._active.clear()
+            raise
+
+    async def _tick_loop_inner(self):
         """The continuous-batching engine: one decode step per iteration
         while any slot is active; frees slots as requests finish."""
         import jax
@@ -214,6 +228,8 @@ class LLMServer:
         slot = await self._admit(list(prompt_ids), max_tokens, eos_id, False)
         ttft = time.perf_counter() - t0
         await slot.done_event.wait()
+        if slot.error is not None:
+            raise RuntimeError("decode engine failed") from slot.error
         toks = slot.generated[:max_tokens]
         if eos_id is not None and eos_id in toks:
             toks = toks[:toks.index(eos_id)]
@@ -231,6 +247,8 @@ class LLMServer:
                 break
             emitted += 1
             yield tok
+        if slot.error is not None:
+            raise RuntimeError("decode engine failed") from slot.error
 
     def stats(self) -> Dict[str, int]:
         return {"active": len(self._active), "free_slots": len(self._free),

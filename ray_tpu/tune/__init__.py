@@ -1,0 +1,31 @@
+"""ray_tpu.tune — hyperparameter tuning (reference: python/ray/tune).
+
+Trials run as resource-holding actors streaming results to the driver loop;
+schedulers (ASHA/HyperBand/median/PBT) act on intermediate results.
+`tune.report` is the same session API as `train.report`.
+"""
+
+from ray_tpu.train.session import get_checkpoint, get_context, report
+from .schedulers import (ASHAScheduler, FIFOScheduler, HyperBandScheduler,
+                         MedianStoppingRule, PopulationBasedTraining,
+                         TrialScheduler)
+from .search import (BasicVariantGenerator, ConcurrencyLimiter,
+                     QuasiBayesSearch, Searcher)
+from .search_space import (choice, grid_search, loguniform, qrandint,
+                           quniform, randint, randn, sample_from, uniform)
+from .stopper import (CombinedStopper, FunctionStopper,
+                      MaximumIterationStopper, Stopper, TrialPlateauStopper)
+from .tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
+                    with_resources)
+
+__all__ = [
+    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "with_resources",
+    "report", "get_checkpoint", "get_context",
+    "choice", "uniform", "quniform", "loguniform", "randint", "qrandint",
+    "randn", "sample_from", "grid_search",
+    "BasicVariantGenerator", "ConcurrencyLimiter", "QuasiBayesSearch",
+    "Searcher", "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
+    "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",
+    "Stopper", "MaximumIterationStopper", "TrialPlateauStopper",
+    "FunctionStopper", "CombinedStopper",
+]

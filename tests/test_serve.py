@@ -19,7 +19,11 @@ def test_autoscale_decision_math():
     assert decide_num_replicas(6, 3, auto) == 3      # 6/2 = 3 → hold
     assert decide_num_replicas(20, 3, auto) == 10    # clamp to max
     assert decide_num_replicas(5, 2, auto) == 3      # ceil(5/2)
-    assert decide_num_replicas(100, 0, auto) == 1    # bootstrap
+    assert decide_num_replicas(100, 0, auto) == 10   # demand from zero
+    zero = AutoscalingConfig(min_replicas=0, max_replicas=5,
+                             target_ongoing_requests=2.0)
+    assert decide_num_replicas(0, 0, zero) == 0      # no flap at zero
+    assert decide_num_replicas(0, 1, zero) == 0      # idle scales to zero
 
 
 def test_batch_coalesces():
@@ -263,3 +267,20 @@ def test_llm_streaming():
 
     toks = asyncio.run(main())
     assert len(toks) == 5
+
+
+def test_redeploy_same_app(serve_session):
+    """serve.run twice on the same app must replace replicas, not crash."""
+    @serve.deployment
+    class V:
+        def __init__(self, v):
+            self.v = v
+
+        def __call__(self):
+            return self.v
+
+    h = serve.run(V.bind(1), name="redeploy")
+    assert h.remote().result(timeout_s=60) == 1
+    h2 = serve.run(V.bind(2), name="redeploy")
+    assert h2.remote().result(timeout_s=60) == 2
+    serve.delete("redeploy")

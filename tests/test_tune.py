@@ -1,0 +1,197 @@
+"""Tune tests (SURVEY.md §4): search-space sampling, ASHA pruning math,
+end-to-end Tuner runs with trials as actors."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import tune
+from ray_tpu.tune.schedulers import CONTINUE, STOP, PBTDecision
+
+
+# ------------------------------------------------------------- search spaces
+def test_search_space_sampling():
+    rng = np.random.default_rng(0)
+    assert tune.choice([1, 2, 3]).sample(rng) in (1, 2, 3)
+    v = tune.uniform(0.0, 1.0).sample(rng)
+    assert 0.0 <= v <= 1.0
+    lv = tune.loguniform(1e-4, 1e-1).sample(rng)
+    assert 1e-4 <= lv <= 1e-1
+    ri = tune.randint(5, 10).sample(rng)
+    assert 5 <= ri < 10
+    q = tune.qrandint(0, 100, 10).sample(rng)
+    assert q % 10 == 0
+    fn = tune.sample_from(lambda: 42)
+    assert fn.sample(rng) == 42
+
+
+def test_basic_variant_grid_cross_product():
+    space = {"a": tune.grid_search([1, 2, 3]),
+             "b": tune.grid_search(["x", "y"]),
+             "c": tune.uniform(0, 1),
+             "d": "const"}
+    gen = tune.BasicVariantGenerator(space, num_samples=2)
+    assert gen.total_trials == 3 * 2 * 2
+    seen = set()
+    for i in range(gen.total_trials):
+        cfg = gen.suggest(f"t{i}")
+        seen.add((cfg["a"], cfg["b"]))
+        assert 0 <= cfg["c"] <= 1 and cfg["d"] == "const"
+    assert gen.suggest("extra") is None
+    assert seen == {(a, b) for a in (1, 2, 3) for b in ("x", "y")}
+
+
+def test_concurrency_limiter():
+    gen = tune.BasicVariantGenerator({"x": tune.uniform(0, 1)}, num_samples=5)
+    lim = tune.ConcurrencyLimiter(gen, max_concurrent=2)
+    assert lim.suggest("t1") is not None
+    assert lim.suggest("t2") is not None
+    assert lim.suggest("t3") is None  # at cap
+    lim.on_trial_complete("t1")
+    assert lim.suggest("t3") is not None
+
+
+# ----------------------------------------------------------------- schedulers
+def test_asha_pruning_math():
+    sched = tune.ASHAScheduler(max_t=16, grace_period=1, reduction_factor=4,
+                               metric="score", mode="max")
+    # 8 trials report at rung t=1 with DESCENDING scores 7..0: the first
+    # sets the cutoff, everyone below it gets culled (async halving)
+    decisions = {}
+    for i in range(8):
+        decisions[i] = sched.on_result(f"t{i}", {"training_iteration": 1,
+                                                 "score": float(7 - i)})
+    assert decisions[0] == CONTINUE  # best, sets the bar
+    assert decisions[3] == STOP      # below the top-1/4 cutoff
+    assert decisions[7] == STOP
+    # horizon reached → stop regardless
+    assert sched.on_result("t7", {"training_iteration": 16,
+                                  "score": 100.0}) == STOP
+
+
+def test_median_stopping():
+    sched = tune.MedianStoppingRule(grace_period=2, min_samples_required=3)
+    sched.set_properties("score", "max")
+    for t in (1, 2, 3):
+        assert sched.on_result("good", {"training_iteration": t,
+                                        "score": 10.0}) == CONTINUE
+        sched.on_result("mid", {"training_iteration": t, "score": 5.0})
+        bad = sched.on_result("bad", {"training_iteration": t, "score": 1.0})
+    assert bad == STOP
+
+
+def test_pbt_exploit_decision():
+    sched = tune.PopulationBasedTraining(
+        perturbation_interval=2,
+        hyperparam_mutations={"lr": [0.1, 0.01]}, seed=0)
+    sched.set_properties("score", "max")
+    sched.register("winner", {"lr": 0.1})
+    sched.register("loser", {"lr": 0.0001})
+    sched.on_result("winner", {"training_iteration": 2, "score": 10.0})
+    d = sched.on_result("loser", {"training_iteration": 2, "score": 0.1})
+    assert isinstance(d, PBTDecision)
+    assert d.source_trial == "winner"
+    assert d.new_config["lr"] in (0.1, 0.01)
+
+
+# ------------------------------------------------------------------- e2e runs
+def test_tuner_end_to_end(ray_session, tmp_path):
+    from ray_tpu.train import RunConfig
+
+    def quadratic(config):
+        # nested def: cloudpickle ships it by value into trial actors
+        for i in range(8):
+            score = -(config["x"] - 3.0) ** 2 - 0.1 * i
+            tune.report({"score": score, "step": i})
+
+    tuner = tune.Tuner(
+        quadratic,
+        param_space={"x": tune.grid_search([0.0, 1.5, 3.0, 5.0])},
+        tune_config=tune.TuneConfig(metric="score", mode="max",
+                                    max_concurrent_trials=2),
+        run_config=RunConfig(name="grid", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 4
+    assert not grid.errors
+    best = grid.get_best_result()
+    assert best.config["x"] == 3.0
+    df = grid.get_dataframe()
+    assert "config/x" in df.columns and len(df) == 4
+
+
+def test_tuner_with_asha_culls(ray_session, tmp_path):
+    from ray_tpu.train import RunConfig
+
+    def slow_trainable(config):
+        import time
+        for i in range(1, 13):
+            time.sleep(0.05)  # slow enough for the driver to act mid-trial
+            tune.report({"score": config["x"], "training_iteration": i})
+
+    # sequential trials (max_concurrent=1) make the cull deterministic: the
+    # good trial populates the rungs first, so the bad one hits a cutoff
+    tuner = tune.Tuner(
+        slow_trainable,
+        param_space={"x": tune.grid_search([4.0, 1.0])},
+        tune_config=tune.TuneConfig(
+            metric="score", mode="max", max_concurrent_trials=1,
+            scheduler=tune.ASHAScheduler(max_t=12, grace_period=2,
+                                         reduction_factor=2)),
+        run_config=RunConfig(name="asha", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid) == 2
+    best = grid.get_best_result()
+    assert best.config["x"] == 4.0
+    # the weak trial must be stopped early by the scheduler
+    iters = {r.config["x"]: len(r.metrics_history) for r in grid}
+    assert iters[4.0] == 12
+    assert iters[1.0] < 12, f"nothing culled: {iters}"
+
+
+def test_tuner_checkpoints_and_errors(ray_session, tmp_path):
+    from ray_tpu.train import Checkpoint, RunConfig
+
+    def ckpt_trainable(config):
+        if config["x"] == 99:
+            raise RuntimeError("doomed trial")
+        for i in range(3):
+            tune.report({"score": i},
+                        checkpoint=Checkpoint.from_state({"i": i}))
+
+    tuner = tune.Tuner(
+        ckpt_trainable,
+        param_space={"x": tune.grid_search([1, 99])},
+        tune_config=tune.TuneConfig(metric="score", mode="max"),
+        run_config=RunConfig(name="ck", storage_path=str(tmp_path)),
+    )
+    grid = tuner.fit()
+    assert len(grid.errors) == 1
+    assert "doomed" in grid.errors[0].error
+    ok = [r for r in grid if not r.error][0]
+    assert ok.checkpoint is not None
+    assert ok.checkpoint.to_state()["i"] == 2
+
+
+def test_tuner_stop_criteria(ray_session, tmp_path):
+    from ray_tpu.train import RunConfig
+
+    def forever(config):
+        import time
+        i = 0
+        while True:
+            i += 1
+            time.sleep(0.01)  # pace reports so the stop lands promptly
+            tune.report({"iters": i, "training_iteration": i})
+
+    tuner = tune.Tuner(
+        forever,
+        param_space={},
+        tune_config=tune.TuneConfig(metric="iters", mode="max"),
+        run_config=RunConfig(name="stop", storage_path=str(tmp_path),
+                             stop={"training_iteration": 5}),
+    )
+    grid = tuner.fit()
+    assert not grid.errors
+    assert grid[0].metrics["training_iteration"] >= 5
+    assert grid[0].metrics["training_iteration"] < 500  # actually stopped
