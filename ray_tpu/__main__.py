@@ -1,0 +1,61 @@
+"""CLI (reference: `ray status` / python/ray/scripts/scripts.py).
+
+`python -m ray_tpu status` prints cluster resources, actors, and store usage
+for a freshly started local runtime; with a driver already running in another
+process, use the state API from that process instead (single-host round 1).
+"""
+
+import argparse
+import json
+import sys
+
+
+def _cmd_status(args):
+    import ray_tpu
+    from ray_tpu.util import state as state_api
+
+    ray_tpu.init(ignore_reinit_error=True)
+    nodes = state_api.list_nodes()
+    print("== Cluster ==")
+    for n in nodes:
+        print(f"node {n['node_id']}  alive={n['alive']}")
+        print(f"  resources: {json.dumps(n['resources'])}")
+        print(f"  available: {json.dumps(n['available'])}")
+        used, cap = n["object_store_used"], n["object_store_capacity"]
+        print(f"  object store: {used}/{cap} bytes")
+    actors = state_api.list_actors()
+    print(f"== Actors ({len(actors)}) ==")
+    for a in actors:
+        print(f"  {a['actor_id']}  {a['state']:<12} name={a['name'] or '-'}")
+    print("== Tasks ==")
+    print(f"  {json.dumps(state_api.summarize_tasks())}")
+    ray_tpu.shutdown()
+
+
+def _cmd_topology(args):
+    from ray_tpu.util import tpu
+    print(json.dumps(tpu.slice_topology(), indent=2))
+
+
+def _cmd_timeline(args):
+    import ray_tpu
+    ray_tpu.init(ignore_reinit_error=True)
+    path = ray_tpu.timeline(args.output)
+    print(f"wrote {path}")
+    ray_tpu.shutdown()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("status", help="cluster resources / actors / tasks")
+    sub.add_parser("topology", help="TPU slice topology")
+    tl = sub.add_parser("timeline", help="export chrome trace")
+    tl.add_argument("--output", default="timeline.json")
+    args = parser.parse_args(argv)
+    {"status": _cmd_status, "topology": _cmd_topology,
+     "timeline": _cmd_timeline}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    main()

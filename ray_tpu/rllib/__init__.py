@@ -6,13 +6,20 @@ update as one jitted program on the TPU.
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
-from .algorithms.ppo import PPO, PPOConfig
+from .algorithms import (APPO, APPOConfig, BC, BCConfig, DQN, DQNConfig,
+                         IMPALA, IMPALAConfig, MARWIL, MARWILConfig, PPO,
+                         PPOConfig, SAC, SACConfig)
+from .buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .env_runner import EnvRunner
 from .learner import JaxLearner, LearnerGroup
 from .rl_module import ModuleSpec, RLModule
 from .sample_batch import SampleBatch
 
 __all__ = [
-    "Algorithm", "AlgorithmConfig", "PPO", "PPOConfig", "EnvRunner",
-    "JaxLearner", "LearnerGroup", "ModuleSpec", "RLModule", "SampleBatch",
+    "Algorithm", "AlgorithmConfig", "EnvRunner", "JaxLearner",
+    "LearnerGroup", "ModuleSpec", "RLModule", "SampleBatch",
+    "ReplayBuffer", "PrioritizedReplayBuffer",
+    "PPO", "PPOConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
+    "IMPALA", "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
+    "MARWIL", "MARWILConfig",
 ]

@@ -1,3 +1,10 @@
+from .appo import APPO, APPOConfig
+from .bc import BC, BCConfig, MARWIL, MARWILConfig
+from .dqn import DQN, DQNConfig
+from .impala import IMPALA, IMPALAConfig
 from .ppo import PPO, PPOConfig
+from .sac import SAC, SACConfig
 
-__all__ = ["PPO", "PPOConfig"]
+__all__ = ["PPO", "PPOConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
+           "IMPALA", "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
+           "MARWIL", "MARWILConfig"]

@@ -1,0 +1,78 @@
+"""APPO (reference: rllib/algorithms/appo/*) — PPO's clipped surrogate on
+V-trace-corrected advantages, tolerating async/stale rollouts.
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.ops.losses import vtrace
+from .. import sample_batch as SB
+from ..connectors import standardize_advantages
+from ..rl_module import RLModule
+from .ppo import PPO, PPOConfig, PPOLearner
+
+
+class APPOConfig(PPOConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = APPO
+        self.vtrace_clip_rho = 1.0
+        self.vtrace_clip_c = 1.0
+        self.num_epochs = 2          # fewer epochs: data is slightly stale
+        self.use_kl_loss = False
+
+
+class APPO(PPO):
+    def setup(self, config: APPOConfig):
+        super().setup(config)
+        spec = self._local_runner.get_spec()
+        self._vtrace_module = self.learner.module
+
+        def targets(params, batch):
+            """V-trace value targets + pg advantages under CURRENT params."""
+            dist_in, values = self._vtrace_module.forward(
+                params, batch[SB.OBS])
+            tlp = self._vtrace_module.dist(dist_in).log_prob(
+                batch[SB.ACTIONS])
+            values_tb1 = jnp.concatenate(
+                [values, batch[SB.BOOTSTRAP_VALUE][None]], axis=0)
+            vt = jax.vmap(
+                lambda blp, t, r, v, d: vtrace(
+                    blp, t, r, v, d, config.gamma,
+                    config.vtrace_clip_rho, config.vtrace_clip_c),
+                in_axes=1, out_axes=1,
+            )(batch[SB.LOGP], tlp, batch[SB.REWARDS], values_tb1,
+              batch[SB.DONES])
+            return vt.pg_advantages, vt.vs
+
+        self._targets = jax.jit(targets)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        weights = self.learner.get_weights()
+        from ..sample_batch import SampleBatch
+        from ..algorithm import _merge_runner_metrics
+        collected, runner_metrics, timesteps = [], [], 0
+        while timesteps < cfg.train_batch_size:
+            batch, rm = self._sample_all(weights)
+            collected.append(batch)
+            runner_metrics.append(rm)
+            timesteps += batch[SB.REWARDS].size
+        batch = (collected[0] if len(collected) == 1 else
+                 SampleBatch.concat(collected, axis=1))
+        # V-trace instead of GAE (the reference's APPO learner path)
+        adv, vs = self._targets(self.learner.params, dict(
+            {k: batch[k] for k in (SB.OBS, SB.ACTIONS, SB.LOGP, SB.REWARDS,
+                                   SB.DONES, SB.BOOTSTRAP_VALUE)}))
+        batch[SB.ADVANTAGES] = np.asarray(adv)
+        batch[SB.VALUE_TARGETS] = np.asarray(vs)
+        if cfg.standardize_advantages:
+            batch = standardize_advantages(batch)
+        learn = self.learner_group.update(batch)
+        result = _merge_runner_metrics(runner_metrics)
+        result["num_env_steps_sampled_this_iter"] = timesteps
+        result["learner"] = learn
+        return result

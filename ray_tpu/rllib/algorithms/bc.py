@@ -1,0 +1,116 @@
+"""BC / MARWIL (reference: rllib/algorithms/bc, rllib/algorithms/marwil).
+
+Offline: learns from a recorded SampleBatch / ray_tpu.data Dataset of
+(obs, actions[, rewards...]) — no env interaction. beta=0 is pure behavior
+cloning; beta>0 weights log-likelihood by exponentiated advantages (MARWIL).
+"""
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import sample_batch as SB
+from ..algorithm import Algorithm, AlgorithmConfig
+from ..learner import JaxLearner, _host_metrics
+from ..rl_module import ModuleSpec, RLModule
+from ..sample_batch import SampleBatch
+
+
+class BCConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = BC
+        self.lr = 1e-3
+        self.beta = 0.0                  # 0 → BC; >0 → MARWIL
+        self.offline_data = None         # SampleBatch | dict | data.Dataset
+        self.train_batch_size = 256
+        self.moving_average_sqd_adv_norm = 100.0
+
+    def offline_data_source(self, data):
+        self.offline_data = data
+        return self
+
+
+class BCLearner(JaxLearner):
+    def compute_loss(self, params, batch):
+        cfg = self.config
+        dist_in, values = self.module.forward(params, batch[SB.OBS])
+        dist = self.module.dist(dist_in)
+        logp = dist.log_prob(batch[SB.ACTIONS])
+        if cfg.beta > 0 and SB.ADVANTAGES in batch:
+            adv = batch[SB.ADVANTAGES]
+            norm = jnp.sqrt(cfg.moving_average_sqd_adv_norm)
+            weights = jnp.exp(cfg.beta * adv / jnp.maximum(norm, 1e-8))
+            loss = -jnp.mean(weights * logp)
+            vf_loss = 0.5 * jnp.mean(jnp.square(
+                values - batch.get(SB.VALUE_TARGETS, adv)))
+            loss = loss + 0.5 * vf_loss
+        else:
+            loss = -jnp.mean(logp)
+        acc = None
+        if dist_in.ndim >= 1 and self.module.spec.action_kind == "discrete":
+            acc = jnp.mean((dist_in.argmax(-1) ==
+                            batch[SB.ACTIONS]).astype(jnp.float32))
+        out = {"bc_logp": jnp.mean(logp)}
+        if acc is not None:
+            out["action_accuracy"] = acc
+        return loss, out
+
+
+class BC(Algorithm):
+    def setup(self, config: BCConfig):
+        data = config.offline_data
+        if data is None:
+            raise ValueError("BC needs config.offline_data")
+        self._data = self._to_arrays(data)
+        n = len(self._data[SB.OBS])
+        obs_shape = self._data[SB.OBS].shape[1:]
+        acts = self._data[SB.ACTIONS]
+        if np.issubdtype(np.asarray(acts).dtype, np.integer):
+            spec = ModuleSpec(obs_shape, "discrete", int(acts.max()) + 1,
+                              tuple(config.model.get("hiddens", (256, 256))))
+        else:
+            spec = ModuleSpec(obs_shape, "continuous",
+                              int(np.prod(np.asarray(acts).shape[1:])),
+                              tuple(config.model.get("hiddens", (256, 256))))
+        self.learner = BCLearner(RLModule(spec), config, seed=config.seed)
+        self._rng = np.random.default_rng(config.seed)
+        self._n = n
+
+    @staticmethod
+    def _to_arrays(data) -> Dict[str, np.ndarray]:
+        if isinstance(data, dict):
+            return {k: np.asarray(v) for k, v in data.items()}
+        if hasattr(data, "take_batch"):  # ray_tpu.data Dataset
+            return data.take_batch(data.count(), batch_format="numpy")
+        raise TypeError(f"unsupported offline data {type(data)}")
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        idx = self._rng.integers(0, self._n, size=cfg.train_batch_size)
+        minibatch = {k: v[idx] for k, v in self._data.items()}
+        learn = _host_metrics([self.learner.update_once(minibatch)])
+        return {"learner": learn,
+                "num_env_steps_sampled_this_iter": 0}
+
+    def evaluate(self):
+        if self.config.env is None:
+            return {}
+        return super().evaluate()
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights):
+        self.learner.set_weights(weights)
+
+
+class MARWILConfig(BCConfig):
+    def __init__(self):
+        super().__init__()
+        self.beta = 1.0
+
+
+MARWIL = BC

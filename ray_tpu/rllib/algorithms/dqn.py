@@ -1,0 +1,220 @@
+"""DQN + Double/Dueling (reference: rllib/algorithms/dqn/*).
+
+TPU framing: the whole TD update (online+target forward, huber, adam) is one
+jitted program; the target network params travel as an explicit input so the
+periodic sync is just a host-side pointer swap, never a retrace.
+"""
+
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.torsos import CNNTorso, MLPTorso
+from ray_tpu.ops.losses import huber
+from .. import sample_batch as SB
+from ..algorithm import Algorithm, AlgorithmConfig, _merge_runner_metrics
+from ..buffers import PrioritizedReplayBuffer, ReplayBuffer
+from ..rl_module import ModuleSpec
+from ..sample_batch import SampleBatch
+
+
+class QNet(nn.Module):
+    spec: ModuleSpec
+    dueling: bool = False
+
+    @nn.compact
+    def __call__(self, obs):
+        spec = self.spec
+        torso = CNNTorso() if spec.use_cnn else MLPTorso(spec.hiddens)
+        z = torso(obs)
+        if self.dueling:
+            adv = nn.Dense(spec.action_dim, name="adv")(z)
+            val = nn.Dense(1, name="val")(z)
+            return val + adv - adv.mean(axis=-1, keepdims=True)
+        return nn.Dense(spec.action_dim, name="q")(z)
+
+
+class DQNModule:
+    """Epsilon-greedy acting over a Q-net; RLModule-compatible surface."""
+
+    def __init__(self, spec: ModuleSpec, dueling: bool = False):
+        if spec.action_kind != "discrete":
+            raise ValueError("DQN needs a discrete action space")
+        self.spec = spec
+        self.net = QNet(spec, dueling)
+
+    def init(self, key):
+        obs = jnp.zeros((1,) + self.spec.obs_shape, jnp.float32)
+        return {"params": self.net.init(key, obs), "epsilon": jnp.asarray(1.0)}
+
+    def _q(self, weights, obs):
+        lead = obs.shape[: obs.ndim - len(self.spec.obs_shape)]
+        flat = obs.reshape((-1,) + self.spec.obs_shape)
+        q = self.net.apply(weights["params"], flat)
+        return q.reshape(lead + (self.spec.action_dim,))
+
+    def forward(self, weights, obs):
+        q = self._q(weights, obs)
+        return q, q.max(axis=-1)
+
+    def explore_step(self, weights, obs, key):
+        q = self._q(weights, obs)
+        greedy = q.argmax(axis=-1)
+        k1, k2 = jax.random.split(key)
+        random_a = jax.random.randint(k1, greedy.shape, 0,
+                                      self.spec.action_dim)
+        take_random = jax.random.uniform(k2, greedy.shape) < weights["epsilon"]
+        action = jnp.where(take_random, random_a, greedy)
+        return action, jnp.zeros(action.shape), q.max(axis=-1)
+
+    def inference_step(self, weights, obs):
+        q = self._q(weights, obs)
+        return q.argmax(axis=-1), q.max(axis=-1)
+
+
+class DQNConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DQN
+        self.lr = 5e-4
+        self.train_batch_size = 32
+        self.replay_buffer_capacity = 50_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.target_network_update_freq = 500   # in SGD steps
+        self.train_intensity = 1                # SGD steps per env step batch
+        self.double_q = True
+        self.dueling = False
+        self.prioritized_replay = False
+        self.per_alpha = 0.6
+        self.per_beta = 0.4
+        self.epsilon_start = 1.0
+        self.epsilon_end = 0.02
+        self.epsilon_decay_steps = 10_000
+        self.rollout_fragment_length = 4
+        self.grad_clip = 10.0
+
+
+class DQN(Algorithm):
+    def setup(self, config: DQNConfig):
+        from ..env_runner import EnvRunner
+        # probe the spaces first: runners need the Q-module at construction
+        probe = EnvRunner(env_creator=config.env, num_envs=1, rollout_len=2)
+        spec = probe.get_spec()
+        probe.close()
+        self.module = DQNModule(spec, dueling=config.dueling)
+        self._setup_runners()
+        key = jax.random.PRNGKey(config.seed)
+        self.weights = self.module.init(key)
+        self.target_params = self.weights["params"]
+        import optax
+        tx = [optax.clip_by_global_norm(config.grad_clip)] \
+            if config.grad_clip else []
+        self.opt = optax.chain(*tx, optax.adam(config.lr))
+        self.opt_state = self.opt.init(self.weights["params"])
+        buf_cls = (PrioritizedReplayBuffer if config.prioritized_replay
+                   else ReplayBuffer)
+        kw = {"alpha": config.per_alpha} if config.prioritized_replay else {}
+        self.buffer = buf_cls(config.replay_buffer_capacity,
+                              seed=config.seed, **kw)
+        self.env_steps = 0
+        self.sgd_steps = 0
+        self._build_update()
+
+    def _make_runner_kwargs(self):
+        kw = super()._make_runner_kwargs()
+        kw["module"] = DQNModule(self.module.spec,
+                                 dueling=self.config.dueling)
+        kw["record_next_obs"] = True
+        return kw
+
+    def _build_update(self):
+        cfg = self.config
+        net = self.module.net
+        gamma = cfg.gamma
+        double_q = cfg.double_q
+
+        def td_loss(params, target_params, batch):
+            q = net.apply(params, batch[SB.OBS])
+            q_taken = jnp.take_along_axis(
+                q, batch[SB.ACTIONS][:, None].astype(jnp.int32), -1)[:, 0]
+            q_next_t = net.apply(target_params, batch[SB.NEXT_OBS])
+            if double_q:
+                a_star = net.apply(params, batch[SB.NEXT_OBS]).argmax(-1)
+                q_next = jnp.take_along_axis(
+                    q_next_t, a_star[:, None], -1)[:, 0]
+            else:
+                q_next = q_next_t.max(-1)
+            target = batch[SB.REWARDS] + gamma * (
+                1.0 - batch[SB.TERMINATEDS]) * q_next
+            td = q_taken - jax.lax.stop_gradient(target)
+            w = batch.get("_weights", jnp.ones_like(td))
+            loss = jnp.mean(w * huber(td))
+            return loss, {"td_abs": jnp.abs(td), "qmean": q_taken.mean()}
+
+        def update(params, target_params, opt_state, batch):
+            (loss, aux), grads = jax.value_and_grad(
+                td_loss, has_aux=True)(params, target_params, batch)
+            updates, opt_state = self.opt.update(grads, opt_state, params)
+            import optax
+            params = optax.apply_updates(params, updates)
+            aux["loss"] = loss
+            return params, opt_state, aux
+
+        self._update = jax.jit(update, donate_argnums=(2,))
+
+    def _epsilon(self) -> float:
+        cfg = self.config
+        frac = min(self.env_steps / max(cfg.epsilon_decay_steps, 1), 1.0)
+        return cfg.epsilon_start + frac * (cfg.epsilon_end - cfg.epsilon_start)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self.weights = {"params": self.weights["params"],
+                        "epsilon": jnp.asarray(self._epsilon())}
+        batch, rm = self._sample_all(jax.device_get(self.weights))
+        flat = batch.flatten()
+        self.env_steps += flat.count
+        self.buffer.add_batch({
+            SB.OBS: flat[SB.OBS], SB.ACTIONS: flat[SB.ACTIONS],
+            SB.REWARDS: flat[SB.REWARDS], SB.NEXT_OBS: flat[SB.NEXT_OBS],
+            SB.TERMINATEDS: flat[SB.TERMINATEDS]})
+
+        metrics: Dict = _merge_runner_metrics([rm])
+        metrics["num_env_steps_sampled_this_iter"] = flat.count
+        metrics["epsilon"] = float(self._epsilon())
+        if self.env_steps < cfg.num_steps_sampled_before_learning_starts:
+            return metrics
+
+        losses = []
+        for _ in range(cfg.train_intensity):
+            if cfg.prioritized_replay:
+                sample = self.buffer.sample(cfg.train_batch_size,
+                                            beta=cfg.per_beta)
+                indices = sample.pop("_indices")
+            else:
+                sample = self.buffer.sample(cfg.train_batch_size)
+                indices = None
+            params, self.opt_state, aux = self._update(
+                self.weights["params"], self.target_params,
+                self.opt_state, sample)
+            self.weights["params"] = params
+            self.sgd_steps += 1
+            if indices is not None:
+                self.buffer.update_priorities(
+                    indices, np.asarray(aux["td_abs"]))
+            losses.append(float(aux["loss"]))
+            if self.sgd_steps % cfg.target_network_update_freq == 0:
+                self.target_params = self.weights["params"]
+        metrics["learner"] = {"loss": float(np.mean(losses)),
+                              "sgd_steps": self.sgd_steps}
+        return metrics
+
+    def get_weights(self):
+        return jax.device_get(self.weights)
+
+    def set_weights(self, weights):
+        self.weights = weights
+        self.target_params = weights["params"]

@@ -1,0 +1,96 @@
+"""IMPALA (reference: rllib/algorithms/impala/*) — V-trace actor-critic.
+
+Off-policy correction comes from `ops.losses.vtrace` (scan-based, vmapped
+over the env axis), so stale-weights rollouts from many runners stay usable.
+The whole [T, B] sequence updates in ONE jitted step — no minibatching, per
+the reference's learner.
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.ops.losses import vtrace
+from .. import sample_batch as SB
+from ..algorithm import Algorithm, AlgorithmConfig, _merge_runner_metrics
+from ..learner import JaxLearner, _host_metrics
+from ..rl_module import RLModule
+from ..sample_batch import SampleBatch
+
+
+class IMPALAConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = IMPALA
+        self.lr = 5e-4
+        self.vf_loss_coeff = 0.5
+        self.entropy_coeff = 0.01
+        self.vtrace_clip_rho = 1.0
+        self.vtrace_clip_c = 1.0
+        self.grad_clip = 40.0
+        self.rollout_fragment_length = 50
+        self.train_batch_size = 500
+
+
+class IMPALALearner(JaxLearner):
+    def compute_loss(self, params, batch):
+        cfg = self.config
+        # [T, B] sequences
+        dist_in, values = self.module.forward(params, batch[SB.OBS])
+        dist = self.module.dist(dist_in)
+        target_logp = dist.log_prob(batch[SB.ACTIONS])
+
+        values_tb1 = jnp.concatenate(
+            [values, batch[SB.BOOTSTRAP_VALUE][None]], axis=0)  # [T+1, B]
+        vt = jax.vmap(
+            lambda blp, tlp, r, v, d: vtrace(
+                blp, tlp, r, v, d, cfg.gamma,
+                cfg.vtrace_clip_rho, cfg.vtrace_clip_c),
+            in_axes=1, out_axes=1,
+        )(batch[SB.LOGP], jax.lax.stop_gradient(target_logp),
+          batch[SB.REWARDS], values_tb1, batch[SB.DONES])
+
+        pg_loss = -jnp.mean(target_logp * jax.lax.stop_gradient(
+            vt.pg_advantages))
+        vf_loss = 0.5 * jnp.mean(
+            jnp.square(values - jax.lax.stop_gradient(vt.vs)))
+        entropy = jnp.mean(dist.entropy())
+        loss = (pg_loss + cfg.vf_loss_coeff * vf_loss
+                - cfg.entropy_coeff * entropy)
+        return loss, {"policy_loss": pg_loss, "vf_loss": vf_loss,
+                      "entropy": entropy}
+
+    def update(self, batch: SampleBatch) -> Dict[str, float]:
+        keys = (SB.OBS, SB.ACTIONS, SB.LOGP, SB.REWARDS, SB.DONES,
+                SB.BOOTSTRAP_VALUE)
+        return _host_metrics([self.update_once({k: batch[k] for k in keys})])
+
+
+class IMPALA(Algorithm):
+    def setup(self, config: IMPALAConfig):
+        self._setup_runners()
+        spec = self._local_runner.get_spec()
+        self.learner = IMPALALearner(RLModule(spec), config, seed=config.seed)
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        weights = self.learner.get_weights()
+        timesteps = 0
+        metrics_list = []
+        learn = {}
+        while timesteps < cfg.train_batch_size:
+            batch, rm = self._sample_all(weights)
+            metrics_list.append(rm)
+            timesteps += batch[SB.REWARDS].size
+            learn = self.learner.update(batch)  # learn per rollout arrival
+        result = _merge_runner_metrics(metrics_list)
+        result["num_env_steps_sampled_this_iter"] = timesteps
+        result["learner"] = learn
+        return result
+
+    def get_weights(self):
+        return self.learner.get_weights()
+
+    def set_weights(self, weights):
+        self.learner.set_weights(weights)

@@ -1,0 +1,243 @@
+"""SAC (reference: rllib/algorithms/sac/*) — squashed-gaussian actor, twin
+critics, auto-tuned temperature. One jitted update covers actor+critic+alpha;
+target critics polyak-update inside the same program.
+"""
+
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.torsos import MLPTorso
+from .. import sample_batch as SB
+from ..algorithm import Algorithm, AlgorithmConfig, _merge_runner_metrics
+from ..buffers import ReplayBuffer
+from ..distributions import SquashedGaussian
+from ..rl_module import ModuleSpec
+
+
+class _Actor(nn.Module):
+    spec: ModuleSpec
+
+    @nn.compact
+    def __call__(self, obs):
+        z = MLPTorso(self.spec.hiddens)(obs)
+        mean = nn.Dense(self.spec.action_dim, name="mean")(z)
+        log_std = nn.Dense(self.spec.action_dim, name="log_std")(z)
+        return mean, log_std
+
+
+class _Critic(nn.Module):
+    spec: ModuleSpec
+
+    @nn.compact
+    def __call__(self, obs, action):
+        x = jnp.concatenate([obs.reshape(obs.shape[0], -1), action], -1)
+        z = MLPTorso(self.spec.hiddens)(x)
+        return nn.Dense(1, name="q")(z)[:, 0]
+
+
+class SACModule:
+    """RLModule-compatible acting surface over the SAC actor."""
+
+    def __init__(self, spec: ModuleSpec, low: float = -1.0, high: float = 1.0):
+        if spec.action_kind != "continuous":
+            raise ValueError("SAC needs a continuous (Box) action space")
+        self.spec = spec
+        self.low, self.high = low, high
+        self.actor = _Actor(spec)
+        self.critic = _Critic(spec)
+
+    def init(self, key):
+        k1, k2, k3 = jax.random.split(key, 3)
+        obs = jnp.zeros((1,) + self.spec.obs_shape, jnp.float32)
+        act = jnp.zeros((1, self.spec.action_dim), jnp.float32)
+        actor = self.actor.init(k1, obs)
+        q1 = self.critic.init(k2, obs, act)
+        q2 = self.critic.init(k3, obs, act)
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        # targets are COPIES: sharing buffers with the online nets would make
+        # the jitted update donate the same buffer twice
+        return {"actor": actor, "q1": q1, "q2": q2,
+                "q1_target": copy(q1), "q2_target": copy(q2),
+                "log_alpha": jnp.asarray(0.0)}
+
+    def _dist(self, weights, obs):
+        flat = obs.reshape((-1,) + self.spec.obs_shape)
+        mean, log_std = self.actor.apply(weights["actor"], flat)
+        return SquashedGaussian(mean, log_std, self.low, self.high), flat.shape[0]
+
+    def forward(self, weights, obs):
+        lead = obs.shape[: obs.ndim - len(self.spec.obs_shape)]
+        dist, _ = self._dist(weights, obs)
+        zeros = jnp.zeros(lead)
+        return dist.base.mean.reshape(lead + (self.spec.action_dim,)), zeros
+
+    def explore_step(self, weights, obs, key):
+        lead = obs.shape[: obs.ndim - len(self.spec.obs_shape)]
+        dist, _ = self._dist(weights, obs)
+        a, logp = dist.sample_and_log_prob(key)
+        return (a.reshape(lead + (self.spec.action_dim,)),
+                logp.reshape(lead), jnp.zeros(lead))
+
+    def inference_step(self, weights, obs):
+        lead = obs.shape[: obs.ndim - len(self.spec.obs_shape)]
+        dist, _ = self._dist(weights, obs)
+        return dist.mode().reshape(lead + (self.spec.action_dim,)), \
+            jnp.zeros(lead)
+
+
+class SACConfig(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = SAC
+        self.lr = 3e-4
+        self.tau = 0.005
+        self.train_batch_size = 256
+        self.replay_buffer_capacity = 100_000
+        self.num_steps_sampled_before_learning_starts = 1000
+        self.train_intensity = 1
+        self.target_entropy = None   # None → -action_dim
+        self.rollout_fragment_length = 8
+
+
+class SAC(Algorithm):
+    def setup(self, config: SACConfig):
+        import gymnasium as gym
+        from ..env_runner import EnvRunner
+        probe = EnvRunner(env_creator=config.env, num_envs=1, rollout_len=2)
+        spec = probe.get_spec()
+        space = probe.envs.single_action_space
+        low = float(np.min(space.low))
+        high = float(np.max(space.high))
+        probe.close()
+        self.module = SACModule(spec, low, high)
+        self._setup_runners()
+        key = jax.random.PRNGKey(config.seed)
+        self.weights = self.module.init(key)
+        import optax
+        self.opt = optax.adam(config.lr)
+        self.opt_state = {
+            "actor": self.opt.init(self.weights["actor"]),
+            "q1": self.opt.init(self.weights["q1"]),
+            "q2": self.opt.init(self.weights["q2"]),
+            "alpha": self.opt.init(self.weights["log_alpha"])}
+        self.buffer = ReplayBuffer(config.replay_buffer_capacity,
+                                   seed=config.seed)
+        self.env_steps = 0
+        self.target_entropy = (config.target_entropy
+                               if config.target_entropy is not None
+                               else -float(spec.action_dim))
+        self._build_update()
+
+    def _make_runner_kwargs(self):
+        kw = super()._make_runner_kwargs()
+        kw["module"] = SACModule(self.module.spec, self.module.low,
+                                 self.module.high)
+        kw["record_next_obs"] = True
+        return kw
+
+    def _build_update(self):
+        cfg = self.config
+        mod = self.module
+        gamma, tau = cfg.gamma, cfg.tau
+        target_entropy = self.target_entropy
+
+        def update(w, opt_state, batch, key):
+            import optax
+            obs, act = batch[SB.OBS], batch[SB.ACTIONS]
+            nxt, rew = batch[SB.NEXT_OBS], batch[SB.REWARDS]
+            done = batch[SB.TERMINATEDS]
+            alpha = jnp.exp(w["log_alpha"])
+            k1, k2 = jax.random.split(key)
+
+            # -- critic target
+            dist_n, _ = mod._dist(w, nxt)
+            a_n, logp_n = dist_n.sample_and_log_prob(k1)
+            q1_n = mod.critic.apply(w["q1_target"], nxt, a_n)
+            q2_n = mod.critic.apply(w["q2_target"], nxt, a_n)
+            target = rew + gamma * (1 - done) * (
+                jnp.minimum(q1_n, q2_n) - alpha * logp_n)
+            target = jax.lax.stop_gradient(target)
+
+            def q_loss(qp, which):
+                q = mod.critic.apply(qp, obs, act)
+                return jnp.mean(jnp.square(q - target))
+
+            l1, g1 = jax.value_and_grad(q_loss)(w["q1"], 1)
+            l2, g2 = jax.value_and_grad(q_loss)(w["q2"], 2)
+            u1, opt_q1 = self.opt.update(g1, opt_state["q1"], w["q1"])
+            u2, opt_q2 = self.opt.update(g2, opt_state["q2"], w["q2"])
+            q1p = optax.apply_updates(w["q1"], u1)
+            q2p = optax.apply_updates(w["q2"], u2)
+
+            # -- actor
+            def pi_loss(ap):
+                mean, log_std = mod.actor.apply(ap, obs)
+                dist = SquashedGaussian(mean, log_std, mod.low, mod.high)
+                a, logp = dist.sample_and_log_prob(k2)
+                q = jnp.minimum(mod.critic.apply(q1p, obs, a),
+                                mod.critic.apply(q2p, obs, a))
+                return jnp.mean(alpha * logp - q), logp
+
+            (la, logp), ga = jax.value_and_grad(
+                pi_loss, has_aux=True)(w["actor"])
+            ua, opt_a = self.opt.update(ga, opt_state["actor"], w["actor"])
+            actor_p = optax.apply_updates(w["actor"], ua)
+
+            # -- temperature
+            def alpha_loss(log_alpha):
+                return -jnp.mean(jnp.exp(log_alpha) *
+                                 jax.lax.stop_gradient(logp + target_entropy))
+
+            lt, gt = jax.value_and_grad(alpha_loss)(w["log_alpha"])
+            ut, opt_t = self.opt.update(gt, opt_state["alpha"], w["log_alpha"])
+            log_alpha = optax.apply_updates(w["log_alpha"], ut)
+
+            # -- polyak target update
+            polyak = lambda t, s: jax.tree_util.tree_map(
+                lambda a, b: (1 - tau) * a + tau * b, t, s)
+            new_w = {"actor": actor_p, "q1": q1p, "q2": q2p,
+                     "q1_target": polyak(w["q1_target"], q1p),
+                     "q2_target": polyak(w["q2_target"], q2p),
+                     "log_alpha": log_alpha}
+            new_opt = {"actor": opt_a, "q1": opt_q1, "q2": opt_q2,
+                       "alpha": opt_t}
+            metrics = {"critic_loss": 0.5 * (l1 + l2), "actor_loss": la,
+                       "alpha": jnp.exp(log_alpha),
+                       "entropy": -jnp.mean(logp)}
+            return new_w, new_opt, metrics
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def training_step(self) -> Dict:
+        cfg = self.config
+        host_w = jax.device_get(self.weights)
+        batch, rm = self._sample_all(host_w)
+        flat = batch.flatten()
+        self.env_steps += flat.count
+        self.buffer.add_batch({
+            SB.OBS: flat[SB.OBS], SB.ACTIONS: flat[SB.ACTIONS],
+            SB.REWARDS: flat[SB.REWARDS], SB.NEXT_OBS: flat[SB.NEXT_OBS],
+            SB.TERMINATEDS: flat[SB.TERMINATEDS]})
+        metrics = _merge_runner_metrics([rm])
+        metrics["num_env_steps_sampled_this_iter"] = flat.count
+        if self.env_steps < cfg.num_steps_sampled_before_learning_starts:
+            return metrics
+        last = {}
+        for i in range(cfg.train_intensity):
+            sample = self.buffer.sample(cfg.train_batch_size)
+            key = jax.random.PRNGKey(self.env_steps + i)
+            self.weights, self.opt_state, last = self._update(
+                self.weights, self.opt_state, sample, key)
+        metrics["learner"] = {k: float(v) for k, v in
+                              jax.device_get(last).items()}
+        return metrics
+
+    def get_weights(self):
+        return jax.device_get(self.weights)
+
+    def set_weights(self, weights):
+        self.weights = weights

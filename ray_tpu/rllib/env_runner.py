@@ -32,8 +32,8 @@ class EnvRunner:
     def __init__(self, env_creator: Union[str, Callable], *,
                  num_envs: int = 1, rollout_len: int = 200,
                  module_spec: Optional[ModuleSpec] = None,
-                 explore: bool = True, seed: int = 0,
-                 gamma: float = 0.99):
+                 module=None, explore: bool = True, seed: int = 0,
+                 gamma: float = 0.99, record_next_obs: bool = False):
         if isinstance(env_creator, str):
             env_id = env_creator
             import gymnasium as gym
@@ -42,9 +42,12 @@ class EnvRunner:
         self.num_envs = num_envs
         self.rollout_len = rollout_len
         self.explore = explore
+        self.record_next_obs = record_next_obs  # off-policy algos need (s, s')
         spec = module_spec or ModuleSpec.from_spaces(
             self.envs.single_observation_space, self.envs.single_action_space)
-        self.module = RLModule(spec)
+        # custom module (e.g. Q-network policies) must expose the RLModule
+        # interface: init/forward/explore_step/inference_step + .spec
+        self.module = module if module is not None else RLModule(spec)
         self.params = None
         self._step_count = 0
         self._seed = seed
@@ -110,6 +113,8 @@ class EnvRunner:
         T, B = self.rollout_len, self.num_envs
         obs_buf = np.empty((T, B) + self.envs.single_observation_space.shape,
                            np.float32)
+        next_obs_buf = (np.empty_like(obs_buf) if self.record_next_obs
+                        else None)
         actions_buf = None
         rewards = np.empty((T, B), np.float32)
         dones = np.empty((T, B), np.float32)
@@ -127,6 +132,8 @@ class EnvRunner:
                 actions_buf = np.empty((T, B) + action.shape[1:], action.dtype)
             next_obs, rew, term, trunc, _info = self.envs.step(action)
             obs_buf[t] = obs
+            if next_obs_buf is not None:
+                next_obs_buf[t] = next_obs
             actions_buf[t] = action
             rewards[t] = rew
             terms[t] = term
@@ -150,11 +157,14 @@ class EnvRunner:
         boot = np.asarray(self._jit_values(self.params, obs.astype(np.float32)))
         boot = boot * (1.0 - terms[-1])
 
-        return SampleBatch({
+        out = SampleBatch({
             SB.OBS: obs_buf, SB.ACTIONS: actions_buf, SB.REWARDS: rewards,
             SB.DONES: dones, SB.TERMINATEDS: terms, SB.LOGP: logps,
             SB.VF_PREDS: vfs, SB.BOOTSTRAP_VALUE: boot,
         })
+        if next_obs_buf is not None:
+            out[SB.NEXT_OBS] = next_obs_buf
+        return out
 
     # -- metrics ------------------------------------------------------------
     def num_completed_episodes(self) -> int:

@@ -18,10 +18,20 @@ __all__ = [
 ]
 
 
+_LAZY = {
+    "ActorPool": ".actor_pool",
+    "Queue": ".queue",
+    "Pool": ".multiprocessing",
+    "metrics": ".metrics",
+    "tpu": ".tpu",
+    "state": ".state",
+}
+
+
 def __getattr__(name):
-    if name in ("ActorPool", "Queue"):
-        import importlib
-        mod = importlib.import_module(".actor_pool" if name == "ActorPool" else ".queue",
-                                      __name__)
-        return getattr(mod, name)
-    raise AttributeError(name)
+    mod_path = _LAZY.get(name)
+    if mod_path is None:
+        raise AttributeError(name)
+    import importlib
+    mod = importlib.import_module(mod_path, __name__)
+    return getattr(mod, name) if hasattr(mod, name) and name[0].isupper() else mod

@@ -1,0 +1,109 @@
+"""ActorPool (reference: python/ray/util/actor_pool.py) — same surface:
+map / map_unordered / submit / get_next / get_next_unordered / has_next /
+has_free / push / pop_idle.
+"""
+
+from typing import Any, Callable, Iterable, List, TypeVar
+
+V = TypeVar("V")
+
+
+class ActorPool:
+    def __init__(self, actors: List[Any]):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        self._next_task_index = 0
+        self._next_return_index = 0
+        self._pending_submits = []
+
+    # -- submission ----------------------------------------------------------
+    def submit(self, fn: Callable[[Any, V], Any], value: V):
+        """fn: lambda (actor, value) -> ObjectRef (call an actor method)."""
+        if self._idle:
+            actor = self._idle.pop()
+            future = fn(actor, value)
+            self._future_to_actor[future] = actor
+            self._index_to_future[self._next_task_index] = future
+            self._next_task_index += 1
+        else:
+            self._pending_submits.append((fn, value))
+
+    def _maybe_drain_pending(self):
+        while self._idle and self._pending_submits:
+            fn, value = self._pending_submits.pop(0)
+            self.submit(fn, value)
+
+    # -- retrieval -----------------------------------------------------------
+    def has_next(self) -> bool:
+        return bool(self._index_to_future) or bool(self._pending_submits)
+
+    def get_next(self, timeout=None):
+        """Next result in submission order."""
+        import ray_tpu
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        idx = self._next_return_index
+        while idx not in self._index_to_future:
+            self._maybe_drain_pending()
+            if not self._index_to_future:
+                raise StopIteration("no pending results")
+        future = self._index_to_future.pop(idx)
+        self._next_return_index += 1
+        value = ray_tpu.get(future, timeout=timeout)
+        self._return_actor(future)
+        return value
+
+    def get_next_unordered(self, timeout=None):
+        """Whichever pending result finishes first."""
+        import ray_tpu
+        self._maybe_drain_pending()
+        if not self._index_to_future:
+            raise StopIteration("no pending results")
+        futures = list(self._index_to_future.values())
+        ready, _ = ray_tpu.wait(futures, num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("no result within timeout")
+        future = ready[0]
+        for i, f in list(self._index_to_future.items()):
+            if f == future:
+                del self._index_to_future[i]
+                if i == self._next_return_index:
+                    while self._next_return_index not in self._index_to_future \
+                            and self._next_return_index < self._next_task_index:
+                        self._next_return_index += 1
+                break
+        value = ray_tpu.get(future)
+        self._return_actor(future)
+        return value
+
+    def _return_actor(self, future):
+        actor = self._future_to_actor.pop(future, None)
+        if actor is not None:
+            self._idle.append(actor)
+            self._maybe_drain_pending()
+
+    # -- bulk ----------------------------------------------------------------
+    def map(self, fn: Callable, values: Iterable[V]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: Iterable[V]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            self._maybe_drain_pending()
+            yield self.get_next_unordered()
+
+    # -- membership ----------------------------------------------------------
+    def has_free(self) -> bool:
+        return bool(self._idle) and not self._pending_submits
+
+    def push(self, actor):
+        self._idle.append(actor)
+        self._maybe_drain_pending()
+
+    def pop_idle(self):
+        return self._idle.pop() if self._idle else None

@@ -1,0 +1,126 @@
+"""Application metrics (reference: python/ray/util/metrics.py Counter/Gauge/
+Histogram).
+
+Per-process registry; `collect()` snapshots everything for scraping, and the
+driver can aggregate worker snapshots via tasks. Tag semantics follow the
+reference: default_tags at construction, per-record overrides.
+"""
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+_registry_lock = threading.Lock()
+_registry: Dict[str, "Metric"] = {}
+
+
+def _tag_key(tags: Optional[Dict[str, str]]) -> Tuple:
+    return tuple(sorted((tags or {}).items()))
+
+
+class Metric:
+    def __init__(self, name: str, description: str = "",
+                 tag_keys: Sequence[str] = ()):
+        self._name = name
+        self._description = description
+        self._tag_keys = tuple(tag_keys)
+        self._default_tags: Dict[str, str] = {}
+        self._lock = threading.Lock()
+        with _registry_lock:
+            _registry[name] = self
+
+    @property
+    def info(self):
+        return {"name": self._name, "description": self._description,
+                "tag_keys": self._tag_keys}
+
+    def set_default_tags(self, tags: Dict[str, str]):
+        self._default_tags = dict(tags)
+        return self
+
+    def _merged(self, tags):
+        out = dict(self._default_tags)
+        out.update(tags or {})
+        return out
+
+
+class Counter(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict] = None):
+        if value < 0:
+            raise ValueError("counters only go up")
+        k = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def snapshot(self):
+        with self._lock:
+            return {"type": "counter", **self.info,
+                    "values": {k: v for k, v in self._values.items()}}
+
+
+class Gauge(Metric):
+    def __init__(self, name, description="", tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, tags: Optional[Dict] = None):
+        with self._lock:
+            self._values[_tag_key(self._merged(tags))] = float(value)
+
+    def inc(self, value: float = 1.0, tags: Optional[Dict] = None):
+        k = _tag_key(self._merged(tags))
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + value
+
+    def dec(self, value: float = 1.0, tags: Optional[Dict] = None):
+        self.inc(-value, tags)
+
+    def snapshot(self):
+        with self._lock:
+            return {"type": "gauge", **self.info,
+                    "values": dict(self._values)}
+
+
+class Histogram(Metric):
+    def __init__(self, name, description="", boundaries: Sequence[float] = (),
+                 tag_keys=()):
+        super().__init__(name, description, tag_keys)
+        if not boundaries:
+            boundaries = [0.001, 0.01, 0.1, 1, 10, 100]
+        self._bounds = sorted(boundaries)
+        self._buckets: Dict[Tuple, List[int]] = {}
+        self._sums: Dict[Tuple, float] = {}
+        self._counts: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, tags: Optional[Dict] = None):
+        k = _tag_key(self._merged(tags))
+        with self._lock:
+            if k not in self._buckets:
+                self._buckets[k] = [0] * (len(self._bounds) + 1)
+            idx = bisect.bisect_left(self._bounds, value)
+            self._buckets[k][idx] += 1
+            self._sums[k] = self._sums.get(k, 0.0) + value
+            self._counts[k] = self._counts.get(k, 0) + 1
+
+    def snapshot(self):
+        with self._lock:
+            return {"type": "histogram", **self.info,
+                    "boundaries": list(self._bounds),
+                    "buckets": {k: list(v) for k, v in self._buckets.items()},
+                    "sum": dict(self._sums), "count": dict(self._counts)}
+
+
+def collect() -> List[Dict]:
+    """Snapshot every metric registered in this process."""
+    with _registry_lock:
+        metrics = list(_registry.values())
+    return [m.snapshot() for m in metrics]
+
+
+def clear_registry():
+    with _registry_lock:
+        _registry.clear()

@@ -1,0 +1,69 @@
+"""State API (reference: python/ray/util/state/api.py — list_actors,
+list_tasks, list_objects, list_nodes, list_workers, summarize_*).
+"""
+
+from typing import Any, Dict, List, Optional
+
+
+def _snapshot(kind: str) -> List[Dict]:
+    from ray_tpu._private import state as _state
+    client = _state.global_client_or_none()
+    if client is None:
+        raise RuntimeError("ray_tpu is not initialized")
+    return client.state(kind)
+
+
+def _filtered(rows: List[Dict], filters) -> List[Dict]:
+    """filters: [(key, "=", value)] triples (reference predicate shape)."""
+    for key, op, value in filters or []:
+        if op in ("=", "=="):
+            rows = [r for r in rows if r.get(key) == value]
+        elif op == "!=":
+            rows = [r for r in rows if r.get(key) != value]
+        else:
+            raise ValueError(f"unsupported filter op {op!r}")
+    return rows
+
+
+def list_actors(filters=None, limit: int = 100) -> List[Dict]:
+    return _filtered(_snapshot("actors"), filters)[:limit]
+
+
+def list_tasks(filters=None, limit: int = 100) -> List[Dict]:
+    return _filtered(_snapshot("tasks"), filters)[:limit]
+
+
+def list_objects(filters=None, limit: int = 100) -> List[Dict]:
+    return _filtered(_snapshot("objects"), filters)[:limit]
+
+
+def list_workers(filters=None, limit: int = 100) -> List[Dict]:
+    return _filtered(_snapshot("workers"), filters)[:limit]
+
+
+def list_nodes(filters=None, limit: int = 100) -> List[Dict]:
+    return _filtered(_snapshot("nodes"), filters)[:limit]
+
+
+def summarize_tasks() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for t in _snapshot("tasks"):
+        out[t["state"]] = out.get(t["state"], 0) + 1
+    return out
+
+
+def summarize_actors() -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for a in _snapshot("actors"):
+        out[a["state"]] = out.get(a["state"], 0) + 1
+    return out
+
+
+def summarize_objects() -> Dict[str, Any]:
+    objs = _snapshot("objects")
+    by_loc: Dict[str, int] = {}
+    total = 0
+    for o in objs:
+        by_loc[o["location"]] = by_loc.get(o["location"], 0) + 1
+        total += o.get("size") or 0
+    return {"count": len(objs), "total_bytes": total, "by_location": by_loc}

@@ -1,0 +1,108 @@
+"""TPU topology helpers (reference: python/ray/_private/accelerators/tpu.py).
+
+Slice topology detection from the TPU runtime env vars (the GKE/GCE metadata
+conventions) with a static table of known slice shapes; everything degrades
+gracefully off-TPU so CPU tests can exercise the logic via env injection.
+"""
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+# generation → (chips per host, cores per chip)
+_GEN_INFO = {
+    "v2": (4, 2), "v3": (4, 2), "v4": (4, 2),
+    "v5e": (8, 1), "v5litepod": (8, 1), "v5p": (4, 2), "v6e": (8, 1),
+}
+
+
+def get_tpu_generation() -> Optional[str]:
+    acc = os.environ.get("TPU_ACCELERATOR_TYPE") or os.environ.get(
+        "PALLAS_AXON_TPU_GEN")
+    if not acc:
+        return None
+    return acc.split("-")[0].lower()
+
+
+def get_accelerator_type() -> Optional[str]:
+    """Full slice name, e.g. "v5e-8" / "v5p-64"."""
+    return os.environ.get("TPU_ACCELERATOR_TYPE")
+
+
+def get_tpu_pod_name() -> Optional[str]:
+    """The slice/pod this host belongs to (reference: TPU_NAME /
+    CLOUD_TPU_TASK_ID conventions)."""
+    return (os.environ.get("TPU_NAME")
+            or os.environ.get("TPU_POD_NAME")
+            or os.environ.get("HOSTNAME"))
+
+
+def get_num_chips_in_slice() -> int:
+    acc = get_accelerator_type()
+    if acc and "-" in acc:
+        try:
+            n = int(acc.split("-")[-1])
+            gen = acc.split("-")[0].lower()
+            cores = _GEN_INFO.get(gen, (4, 1))[1]
+            # accelerator_type counts CORES for v2-v4 ("v4-8" = 4 chips) and
+            # CHIPS for v5e ("v5e-8" = 8 chips)
+            return n // cores if cores > 1 else n
+        except ValueError:
+            pass
+    try:
+        import jax
+        return sum(1 for d in jax.devices() if d.platform != "cpu")
+    except Exception:  # noqa: BLE001 - no runtime
+        return 0
+
+
+def get_chips_per_host(gen: Optional[str] = None) -> int:
+    gen = gen or get_tpu_generation() or "v5e"
+    return _GEN_INFO.get(gen, (4, 1))[0]
+
+
+def get_num_hosts_in_slice() -> int:
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES")
+    if hosts:
+        return len(hosts.split(","))
+    chips = get_num_chips_in_slice()
+    per = get_chips_per_host()
+    return max(-(-chips // per), 1) if chips else 1
+
+
+def get_worker_id() -> int:
+    return int(os.environ.get("TPU_WORKER_ID", 0))
+
+
+def visible_chip_ids() -> List[int]:
+    """Chips bound to this process (set by the scheduler's chip binding)."""
+    env = os.environ.get("TPU_VISIBLE_CHIPS") or os.environ.get("RAY_TPU_IDS")
+    if env:
+        return [int(x) for x in env.split(",") if x != ""]
+    try:
+        import jax
+        return [d.id for d in jax.devices() if d.platform != "cpu"]
+    except Exception:  # noqa: BLE001
+        return []
+
+
+def slice_topology() -> Dict:
+    """One-stop topology summary for schedulers/trainers."""
+    gen = get_tpu_generation()
+    return {
+        "generation": gen,
+        "accelerator_type": get_accelerator_type(),
+        "pod_name": get_tpu_pod_name(),
+        "num_chips": get_num_chips_in_slice(),
+        "num_hosts": get_num_hosts_in_slice(),
+        "chips_per_host": get_chips_per_host(gen),
+        "worker_id": get_worker_id(),
+    }
+
+
+def mesh_shape_for_slice(tp: int = 1) -> Tuple[int, int]:
+    """(dp_like, tp) factorization of this slice's chips — the default mesh
+    recipe when the user doesn't pick one."""
+    chips = max(get_num_chips_in_slice(), 1)
+    if chips % tp:
+        raise ValueError(f"tp={tp} does not divide {chips} chips")
+    return chips // tp, tp

@@ -1,0 +1,179 @@
+"""Util belt tests: ActorPool, Queue, Pool, metrics, tpu topology, state API,
+timeline export, CLI."""
+
+import time
+
+import pytest
+
+
+def test_actor_pool_ordered_and_unordered(ray_session):
+    ray = ray_session
+    from ray_tpu.util import ActorPool
+
+    @ray.remote
+    class Worker:
+        def double(self, x):
+            return x * 2
+
+    pool = ActorPool([Worker.remote(), Worker.remote()])
+    out = list(pool.map(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]  # submission order preserved
+
+    out = sorted(pool.map_unordered(lambda a, v: a.double.remote(v), range(6)))
+    assert out == [0, 2, 4, 6, 8, 10]
+
+    # submit/get_next with backpressure past pool size
+    for i in range(5):
+        pool.submit(lambda a, v: a.double.remote(v), i)
+    got = [pool.get_next(timeout=60) for _ in range(5)]
+    assert got == [0, 2, 4, 6, 8]
+
+
+def test_queue_basics(ray_session):
+    from ray_tpu.util import Queue
+    from ray_tpu.util.queue import Empty, Full
+
+    q = Queue(maxsize=2)
+    q.put(1)
+    q.put(2)
+    with pytest.raises(Full):
+        q.put(3, block=False)
+    assert q.qsize() == 2 and q.full()
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.empty()
+    with pytest.raises(Empty):
+        q.get(block=False)
+    with pytest.raises(Empty):
+        q.get(timeout=0.1)
+    q.put_nowait_batch([7, 8])
+    assert q.get_nowait_batch(2) == [7, 8]
+    q.shutdown()
+
+
+def test_queue_shared_between_tasks(ray_session):
+    ray = ray_session
+    from ray_tpu.util import Queue
+
+    q = Queue()
+
+    @ray.remote
+    def producer(queue, n):
+        for i in range(n):
+            queue.put(i)
+        return "done"
+
+    assert ray.get(producer.remote(q, 3), timeout=60) == "done"
+    assert [q.get(timeout=10) for _ in range(3)] == [0, 1, 2]
+    q.shutdown()
+
+
+def test_multiprocessing_pool(ray_session):
+    from ray_tpu.util.multiprocessing import Pool
+
+    with Pool() as p:
+        assert p.map(lambda x: x * x, range(5)) == [0, 1, 4, 9, 16]
+        r = p.apply_async(lambda a, b: a + b, (2, 3))
+        assert r.get(timeout=60) == 5
+        assert p.apply(lambda: 7) == 7
+        assert p.starmap(lambda a, b: a * b, [(2, 3), (4, 5)]) == [6, 20]
+        assert sorted(p.imap_unordered(lambda x: x + 1, range(4))) == [1, 2, 3, 4]
+
+
+def test_metrics():
+    from ray_tpu.util import metrics
+
+    metrics.clear_registry()
+    c = metrics.Counter("requests", "total requests", ("route",))
+    c.inc()
+    c.inc(2, tags={"route": "/a"})
+    with pytest.raises(ValueError):
+        c.inc(-1)
+
+    g = metrics.Gauge("inflight")
+    g.set(5)
+    g.dec()
+
+    h = metrics.Histogram("latency", boundaries=[0.1, 1.0])
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+
+    snap = {m["name"]: m for m in metrics.collect()}
+    assert snap["requests"]["values"][()] == 1
+    assert snap["requests"]["values"][(("route", "/a"),)] == 2
+    assert snap["inflight"]["values"][()] == 4
+    assert snap["latency"]["buckets"][()] == [1, 1, 1]
+    assert snap["latency"]["count"][()] == 3
+    metrics.clear_registry()
+
+
+def test_tpu_topology(monkeypatch):
+    from ray_tpu.util import tpu
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-16")
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "host0,host1")
+    monkeypatch.setenv("TPU_WORKER_ID", "1")
+    monkeypatch.setenv("TPU_NAME", "my-slice")
+    topo = tpu.slice_topology()
+    assert topo["generation"] == "v5e"
+    assert topo["num_chips"] == 16
+    assert topo["num_hosts"] == 2
+    assert topo["chips_per_host"] == 8
+    assert topo["worker_id"] == 1
+    assert topo["pod_name"] == "my-slice"
+    assert tpu.mesh_shape_for_slice(tp=4) == (4, 4)
+
+    # v4 counts cores in the accelerator string
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    assert tpu.get_num_chips_in_slice() == 4
+
+
+def test_state_api(ray_session):
+    ray = ray_session
+    from ray_tpu.util import state as state_api
+
+    @ray.remote
+    class Pinger:
+        def ping(self):
+            return "pong"
+
+    p = Pinger.options(name="state-test-actor").remote()
+    ray.get(p.ping.remote(), timeout=60)
+
+    actors = state_api.list_actors(filters=[("name", "=", "state-test-actor")])
+    assert len(actors) == 1 and actors[0]["state"] == "ALIVE"
+    assert state_api.summarize_actors().get("ALIVE", 0) >= 1
+    tasks = state_api.list_tasks()
+    assert any(t["name"].endswith("ping") for t in tasks)
+    objs = state_api.summarize_objects()
+    assert objs["count"] >= 1
+    nodes = state_api.list_nodes()
+    assert nodes and nodes[0]["alive"]
+    ray.kill(p)
+
+
+def test_timeline_export(ray_session, tmp_path):
+    ray = ray_session
+
+    @ray.remote
+    def traced():
+        return 1
+
+    ray.get([traced.remote() for _ in range(3)])
+    out = str(tmp_path / "trace.json")
+    ray.timeline(out)
+    import json
+    with open(out) as f:
+        events = json.load(f)
+    assert isinstance(events, list) and len(events) >= 3
+    assert all("ts" in e and "dur" in e for e in events
+               if e.get("ph") == "X")
+
+
+def test_cli_topology(monkeypatch, capsys):
+    from ray_tpu.__main__ import main
+
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+    main(["topology"])
+    out = capsys.readouterr().out
+    assert '"generation": "v5e"' in out
