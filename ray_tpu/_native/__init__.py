@@ -1,0 +1,3 @@
+"""Native components (C++, ctypes-bound). Built on demand with g++; every
+module here degrades gracefully to a pure-python fallback when the toolchain
+is missing."""

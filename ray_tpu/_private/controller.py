@@ -124,7 +124,7 @@ class Controller:
         self.job_id = job_id
         self.node_id = ids.node_id()
         self.loop: asyncio.AbstractEventLoop = None
-        self.store = StoreClient()
+        self.store = StoreClient(create_arena=True)
         self.total = dict(resources)
         self.available = dict(resources)
         self.max_workers = max_workers or (int(resources.get("CPU", 1)) + 2)
@@ -169,7 +169,8 @@ class Controller:
                 except OSError:
                     pass
         self.objects.clear()
-        self.store.close()
+        self.store.close(unlink_arena=True)
+        os.environ.pop("RAY_TPU_ARENA", None)
         try:
             os.unlink(self.socket_path)
         except OSError:

@@ -67,16 +67,50 @@ class LocalObject:
 
 
 class StoreClient:
-    """Per-process store client. Thread-safe for CPython practical purposes."""
+    """Per-process store client. Thread-safe for CPython practical purposes.
 
-    def __init__(self):
+    Backend selection: when the session publishes an arena name
+    (RAY_TPU_ARENA env, set by init()) and the native slab store builds, all
+    objects live in ONE C++ shm arena (src/shm_store.cpp) — allocation is a
+    lock+freelist op instead of a per-object shm_open/mmap. Otherwise each
+    object gets its own POSIX segment (portable fallback;
+    RAY_TPU_STORE_BACKEND=pershm forces it).
+
+    Zero-copy contract (same as the reference's plasma rule): values returned
+    by get() alias store memory and are valid while the caller's ObjectRef
+    keeps the object alive; don't stash the buffers past the ref.
+    """
+
+    def __init__(self, create_arena: bool = False):
         self._attached = {}  # object_id -> LocalObject (pins shm while in use)
+        self._slab = None
+        arena = os.environ.get("RAY_TPU_ARENA")
+        if arena and os.environ.get("RAY_TPU_STORE_BACKEND") != "pershm":
+            try:
+                from ray_tpu._native.store import SlabStore
+                capacity = int(os.environ.get("RAY_TPU_STORE_BYTES", 8 << 30))
+                self._slab = SlabStore(arena, capacity, create=create_arena)
+            except Exception:  # noqa: BLE001 - no toolchain → per-seg fallback
+                self._slab = None
+
+    @property
+    def backend(self) -> str:
+        return "slab" if self._slab is not None else "pershm"
 
     # -- write path ---------------------------------------------------------
     # (no whole-object put here: serialization must flow through the clients'
     # _encode_to_store so contained ObjectRef ids are never dropped)
     def put_parts(self, object_id: str, meta: bytes, buffers) -> int:
         size = serialization.total_size(meta, buffers)
+        if self._slab is not None:
+            off = self._slab.alloc(object_id, max(size, 1))
+            mv = self._slab.view(off, max(size, 1))
+            mv[: len(meta)] = meta
+            pos = len(meta)
+            for b in buffers:
+                mv[pos : pos + b.nbytes] = b
+                pos += b.nbytes
+            return size
         try:
             shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True,
                                              size=max(size, 1))
@@ -98,6 +132,10 @@ class StoreClient:
 
     def put_raw(self, object_id: str, blob: bytes) -> int:
         """Store pre-packed bytes (used when restoring spilled objects)."""
+        if self._slab is not None:
+            off = self._slab.alloc(object_id, max(len(blob), 1))
+            self._slab.view(off, len(blob))[:] = blob
+            return len(blob)
         shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True, size=max(len(blob), 1))
         _unregister(shm)
         shm.buf[: len(blob)] = blob
@@ -106,10 +144,19 @@ class StoreClient:
 
     # -- read path ----------------------------------------------------------
     def get(self, object_id: str, meta_len: int):
-        """Attach and deserialize; buffers alias the segment (zero-copy)."""
+        """Attach and deserialize; buffers alias store memory (zero-copy)."""
         cached = self._attached.get(object_id)
         if cached is not None:
             return cached.value
+        if self._slab is not None:
+            loc = self._slab.lookup(object_id)
+            if loc is None:
+                raise FileNotFoundError(f"object {object_id} not in arena")
+            off, size = loc
+            mv = self._slab.view(off, size)
+            value = serialization.loads_oob(mv[:meta_len], mv[meta_len:])
+            self._attached[object_id] = LocalObject(None, value, size)
+            return value
         shm = shared_memory.SharedMemory(name=seg_name(object_id))
         _unregister(shm)
         mv = shm.buf
@@ -118,6 +165,11 @@ class StoreClient:
         return value
 
     def read_raw(self, object_id: str) -> bytes:
+        if self._slab is not None:
+            loc = self._slab.lookup(object_id)
+            if loc is None:
+                raise FileNotFoundError(object_id)
+            return bytes(self._slab.view(*loc))
         shm = shared_memory.SharedMemory(name=seg_name(object_id))
         _unregister(shm)
         data = bytes(shm.buf)
@@ -126,7 +178,7 @@ class StoreClient:
 
     def release(self, object_id: str):
         loc = self._attached.pop(object_id, None)
-        if loc is not None:
+        if loc is not None and loc.shm is not None:
             loc.value = None
             try:
                 loc.shm.close()
@@ -135,8 +187,11 @@ class StoreClient:
                 self._attached[object_id] = loc
 
     def delete_segment(self, object_id: str):
-        """Unlink the segment (controller-side eviction)."""
+        """Free the object's storage (controller-side eviction)."""
         self.release(object_id)
+        if self._slab is not None:
+            self._slab.free(object_id)
+            return
         try:
             shm = shared_memory.SharedMemory(name=seg_name(object_id))
             _unregister(shm)
@@ -147,7 +202,7 @@ class StoreClient:
 
     # -- spilling ------------------------------------------------------------
     def spill(self, object_id: str) -> str:
-        """Copy segment to disk and unlink it. Returns the spill path."""
+        """Copy object to disk and free it. Returns the spill path."""
         os.makedirs(_SPILL_DIR, exist_ok=True)
         path = os.path.join(_SPILL_DIR, seg_name(object_id))
         data = self.read_raw(object_id)
@@ -162,6 +217,9 @@ class StoreClient:
         os.remove(path)
         return self.put_raw(object_id, blob)
 
-    def close(self):
+    def close(self, unlink_arena: bool = False):
         for oid in list(self._attached):
             self.release(oid)
+        if self._slab is not None:
+            self._slab.close(unlink=unlink_arena)
+            self._slab = None

@@ -71,10 +71,15 @@ def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
             total["TPU"] = float(ntpu)
         total.setdefault("memory", 64 << 30)
         sock = os.path.join(tempfile.gettempdir(), f"rtpu-{os.getpid()}-{ids.new_id('s')[-8:]}.sock")
+        # publish the arena name BEFORE the controller builds its store;
+        # workers inherit the env and attach to the same C++ shm arena
+        capacity = object_store_memory or DEFAULT_CAPACITY
+        os.environ["RAY_TPU_ARENA"] = f"rtpu-arena-{os.getpid()}-{ids.new_id('a')[-8:]}"
+        os.environ["RAY_TPU_STORE_BYTES"] = str(capacity)
         controller = Controller(
             sock, total, job_id=ids.job_id(),
             max_workers=max_workers,
-            store_capacity=object_store_memory or DEFAULT_CAPACITY)
+            store_capacity=capacity)
 
         loop = asyncio.new_event_loop()
         started = threading.Event()

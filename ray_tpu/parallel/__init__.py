@@ -13,6 +13,10 @@ from .sharding import (
     shard_tree,
     tree_paths,
 )
+from .distributed import (barrier, initialize_multihost, is_multihost,
+                          process_count, process_index)
+from .pipeline import (make_microbatches, pipeline_apply,
+                       shard_pipeline_params, stack_stage_params)
 from . import collective
 from . import xla_ops
 
@@ -20,4 +24,7 @@ __all__ = [
     "AXIS_ORDER", "make_mesh", "auto_mesh", "hybrid_mesh", "local_cpu_mesh",
     "ShardingRules", "llama_rules", "batch_spec", "data_sharding", "shard_tree",
     "tree_paths", "collective", "xla_ops",
+    "pipeline_apply", "make_microbatches", "stack_stage_params",
+    "shard_pipeline_params", "initialize_multihost", "is_multihost",
+    "process_index", "process_count", "barrier",
 ]

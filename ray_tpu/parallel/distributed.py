@@ -1,0 +1,74 @@
+"""Multi-host initialization (SURVEY.md §2 multi-host story; reference
+contrast: Ray spans hosts with GCS over TCP + NCCL — here each host runs the
+same SPMD program and jax.distributed wires the runtime, after which DCN
+collectives come from the compiler like ICI ones).
+
+Usage on every host of a slice:
+    ray_tpu.parallel.initialize_multihost()     # env-driven defaults
+    mesh = hybrid_mesh({"fsdp": 4, "tp": 2}, {"dp": num_hosts})
+"""
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Idempotent jax.distributed bring-up. Args default from the TPU env
+    (TPU_WORKER_HOSTNAMES / TPU_WORKER_ID — the same vars the topology
+    helpers read). Returns True when running multi-host."""
+    global _initialized
+    import jax
+
+    if _initialized:
+        return jax.process_count() > 1
+
+    hosts = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    host_list = [h for h in hosts.split(",") if h]
+    if num_processes is None:
+        num_processes = len(host_list) or 1
+    if num_processes <= 1:
+        _initialized = True
+        return False
+    if coordinator_address is None:
+        coordinator_address = f"{host_list[0]}:8476"
+    if process_id is None:
+        process_id = int(os.environ.get("TPU_WORKER_ID", 0))
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id)
+    _initialized = True
+    return True
+
+
+def process_index() -> int:
+    import jax
+    try:
+        return jax.process_index()
+    except Exception:  # noqa: BLE001 - not initialized → single process
+        return 0
+
+
+def process_count() -> int:
+    import jax
+    try:
+        return jax.process_count()
+    except Exception:  # noqa: BLE001
+        return 1
+
+
+def is_multihost() -> bool:
+    return process_count() > 1
+
+
+def barrier(name: str = "barrier"):
+    """Cross-host sync: a tiny psum over all devices forces a global
+    rendezvous (reference: ray.util.collective barrier over NCCL)."""
+    import jax
+    import jax.numpy as jnp
+    jax.device_get(jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+        jnp.ones((jax.local_device_count(),))))

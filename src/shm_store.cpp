@@ -1,0 +1,344 @@
+// Shared-memory slab object store (reference: Ray's plasma store,
+// src/ray/object_manager/plasma — a C++ arena with a slab allocator that
+// clients map zero-copy). Re-designed daemonless for the single-host
+// controller runtime: ONE POSIX shm arena per session; every process mmaps
+// it at open and allocates/looks up under a process-shared robust mutex
+// living inside the arena itself. No socket round-trips on the data path —
+// an object lookup is a hash probe in shared memory.
+//
+// Layout:
+//   [Header | object table (open addressing) | data heap]
+// Heap blocks carry {size,next} headers on a sorted free list; allocation is
+// first-fit with split, free coalesces with both neighbors via the sort.
+//
+// C ABI at the bottom (ctypes-bound from ray_tpu/_native/store.py).
+
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <cstdio>
+#include <fcntl.h>
+#include <pthread.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint64_t kMagic = 0x52545055534c4142ull;  // "RTPUSLAB"
+constexpr uint32_t kKeyLen = 31;
+constexpr uint32_t kTableSlots = 1 << 16;  // 64k objects
+constexpr uint64_t kAlign = 64;            // cache-line align payloads
+constexpr int64_t kNil = -1;
+
+enum SlotState : uint32_t { kEmpty = 0, kUsed = 1, kTombstone = 2 };
+
+struct Slot {
+  char key[kKeyLen + 1];
+  uint64_t offset;  // payload offset from arena base
+  uint64_t size;
+  uint32_t state;
+  uint32_t pad;
+};
+
+struct FreeBlock {
+  uint64_t size;   // bytes of this free block INCLUDING this header
+  int64_t next;    // offset of next free block (sorted ascending), or kNil
+};
+
+struct Header {
+  uint64_t magic;
+  uint64_t capacity;    // total arena bytes
+  uint64_t heap_start;  // offset of heap begin
+  uint64_t used;        // payload bytes currently allocated
+  uint64_t num_objects;
+  pthread_mutex_t lock;
+  int64_t free_head;    // offset of first free block
+  Slot table[kTableSlots];
+};
+
+struct Handle {
+  void* base;
+  uint64_t capacity;
+  int owner;
+  char name[128];
+};
+
+inline Header* header_of(Handle* h) { return reinterpret_cast<Header*>(h->base); }
+
+inline uint64_t align_up(uint64_t v, uint64_t a) { return (v + a - 1) & ~(a - 1); }
+
+uint64_t hash_key(const char* key) {
+  // FNV-1a
+  uint64_t h = 1469598103934665603ull;
+  for (const char* p = key; *p; ++p) {
+    h ^= static_cast<uint8_t>(*p);
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+Slot* find_slot(Header* hd, const char* key, bool for_insert) {
+  uint64_t idx = hash_key(key) & (kTableSlots - 1);
+  Slot* first_tomb = nullptr;
+  for (uint32_t probe = 0; probe < kTableSlots; ++probe) {
+    Slot* s = &hd->table[(idx + probe) & (kTableSlots - 1)];
+    if (s->state == kUsed && std::strncmp(s->key, key, kKeyLen) == 0) return s;
+    if (s->state == kTombstone && for_insert && !first_tomb) first_tomb = s;
+    if (s->state == kEmpty) return for_insert ? (first_tomb ? first_tomb : s) : nullptr;
+  }
+  return for_insert ? first_tomb : nullptr;
+}
+
+void lock(Header* hd) {
+  int rc = pthread_mutex_lock(&hd->lock);
+  if (rc == EOWNERDEAD) pthread_mutex_consistent(&hd->lock);  // robust: heal
+}
+
+void unlock(Header* hd) { pthread_mutex_unlock(&hd->lock); }
+
+// Insert a block at `off` with `size` into the sorted free list, coalescing.
+void free_list_insert(Header* hd, char* base, int64_t off, uint64_t size) {
+  int64_t prev = kNil, cur = hd->free_head;
+  while (cur != kNil && cur < off) {
+    prev = cur;
+    cur = reinterpret_cast<FreeBlock*>(base + cur)->next;
+  }
+  auto* blk = reinterpret_cast<FreeBlock*>(base + off);
+  blk->size = size;
+  blk->next = cur;
+  if (prev == kNil) {
+    hd->free_head = off;
+  } else {
+    auto* pb = reinterpret_cast<FreeBlock*>(base + prev);
+    pb->next = off;
+    if (prev + static_cast<int64_t>(pb->size) == off) {  // merge prev+blk
+      pb->size += blk->size;
+      pb->next = blk->next;
+      blk = pb;
+      off = prev;
+    }
+  }
+  if (blk->next != kNil &&
+      off + static_cast<int64_t>(blk->size) == blk->next) {  // merge blk+next
+    auto* nb = reinterpret_cast<FreeBlock*>(base + blk->next);
+    blk->size += nb->size;
+    blk->next = nb->next;
+  }
+}
+
+// First-fit allocate `need` bytes (already including header+align). Returns
+// block offset or kNil.
+int64_t free_list_take(Header* hd, char* base, uint64_t need) {
+  int64_t prev = kNil, cur = hd->free_head;
+  while (cur != kNil) {
+    auto* blk = reinterpret_cast<FreeBlock*>(base + cur);
+    if (blk->size >= need) {
+      uint64_t remainder = blk->size - need;
+      int64_t next;
+      if (remainder >= sizeof(FreeBlock) + kAlign) {
+        int64_t rest = cur + static_cast<int64_t>(need);
+        auto* rb = reinterpret_cast<FreeBlock*>(base + rest);
+        rb->size = remainder;
+        rb->next = blk->next;
+        next = rest;
+        blk->size = need;
+      } else {
+        next = blk->next;
+      }
+      if (prev == kNil) hd->free_head = next;
+      else reinterpret_cast<FreeBlock*>(base + prev)->next = next;
+      return cur;
+    }
+    prev = cur;
+    cur = blk->next;
+  }
+  return kNil;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* rt_store_open(const char* name, uint64_t capacity, int create) {
+  char shm_name[128];
+  std::snprintf(shm_name, sizeof(shm_name), "/%s", name);
+  int fd = -1;
+  bool creating = false;
+  // the header (object table) needs ~4MB; refuse arenas that can't hold it
+  // plus a sane heap instead of writing past the mapping
+  if (create && capacity < sizeof(Header) + (8u << 20)) return nullptr;
+  if (create) {
+    fd = shm_open(shm_name, O_RDWR | O_CREAT | O_EXCL, 0600);
+    if (fd >= 0) {
+      creating = true;
+    } else if (errno == EEXIST) {
+      fd = shm_open(shm_name, O_RDWR, 0600);
+    }
+  } else {
+    fd = shm_open(shm_name, O_RDWR, 0600);
+  }
+  if (fd < 0) return nullptr;
+
+  if (creating) {
+    if (ftruncate(fd, static_cast<off_t>(capacity)) != 0) {
+      close(fd);
+      shm_unlink(shm_name);
+      return nullptr;
+    }
+  } else {
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size == 0) {
+      close(fd);
+      return nullptr;
+    }
+    capacity = static_cast<uint64_t>(st.st_size);
+  }
+
+  void* base =
+      mmap(nullptr, capacity, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+
+  auto* hd = reinterpret_cast<Header*>(base);
+  if (creating) {
+    std::memset(hd, 0, sizeof(Header));
+    hd->capacity = capacity;
+    hd->heap_start = align_up(sizeof(Header), kAlign);
+    hd->used = 0;
+    hd->num_objects = 0;
+    pthread_mutexattr_t attr;
+    pthread_mutexattr_init(&attr);
+    pthread_mutexattr_setpshared(&attr, PTHREAD_PROCESS_SHARED);
+    pthread_mutexattr_setrobust(&attr, PTHREAD_MUTEX_ROBUST);
+    pthread_mutex_init(&hd->lock, &attr);
+    pthread_mutexattr_destroy(&attr);
+    hd->free_head = static_cast<int64_t>(hd->heap_start);
+    auto* first = reinterpret_cast<FreeBlock*>(
+        static_cast<char*>(base) + hd->heap_start);
+    first->size = capacity - hd->heap_start;
+    first->next = kNil;
+    hd->magic = kMagic;  // publish last
+    __sync_synchronize();
+  } else {
+    // spin briefly for a concurrent creator to publish
+    for (int i = 0; i < 100000 && hd->magic != kMagic; ++i) sched_yield();
+    if (hd->magic != kMagic) {
+      munmap(base, capacity);
+      if (create == 1) {
+        // stale arena from a crashed creator: reclaim it once
+        shm_unlink(shm_name);
+        return rt_store_open(name, capacity, 2 /* create, no retry */);
+      }
+      return nullptr;
+    }
+  }
+
+  auto* h = new Handle();
+  h->base = base;
+  h->capacity = hd->capacity;
+  h->owner = creating ? 1 : 0;
+  std::snprintf(h->name, sizeof(h->name), "%s", shm_name);
+  return h;
+}
+
+int rt_store_close(void* hv, int unlink_arena) {
+  auto* h = static_cast<Handle*>(hv);
+  if (!h) return -1;
+  munmap(h->base, h->capacity);
+  if (unlink_arena) shm_unlink(h->name);
+  delete h;
+  return 0;
+}
+
+// Allocate `size` bytes for `key`; returns payload offset or -1 (full /
+// duplicate-overwrite-failed / table full).
+int64_t rt_store_alloc(void* hv, const char* key, uint64_t size) {
+  auto* h = static_cast<Handle*>(hv);
+  auto* hd = header_of(h);
+  char* base = static_cast<char*>(h->base);
+  uint64_t need = align_up(size + sizeof(FreeBlock), kAlign);
+  lock(hd);
+  Slot* existing = find_slot(hd, key, false);
+  if (existing) {  // overwrite semantics: free then re-alloc
+    free_list_insert(hd, base, static_cast<int64_t>(existing->offset) -
+                                   static_cast<int64_t>(sizeof(FreeBlock)),
+                     align_up(existing->size + sizeof(FreeBlock), kAlign));
+    hd->used -= existing->size;
+    hd->num_objects--;
+    existing->state = kTombstone;
+  }
+  int64_t blk = free_list_take(hd, base, need);
+  if (blk == kNil) {
+    unlock(hd);
+    return -1;
+  }
+  Slot* s = find_slot(hd, key, true);
+  if (!s) {  // table full: roll back
+    free_list_insert(hd, base, blk, need);
+    unlock(hd);
+    return -1;
+  }
+  std::strncpy(s->key, key, kKeyLen);
+  s->key[kKeyLen] = '\0';
+  s->offset = static_cast<uint64_t>(blk) + sizeof(FreeBlock);
+  s->size = size;
+  s->state = kUsed;
+  hd->used += size;
+  hd->num_objects++;
+  unlock(hd);
+  return static_cast<int64_t>(s->offset);
+}
+
+int64_t rt_store_lookup(void* hv, const char* key, uint64_t* size_out) {
+  auto* h = static_cast<Handle*>(hv);
+  auto* hd = header_of(h);
+  lock(hd);
+  Slot* s = find_slot(hd, key, false);
+  if (!s) {
+    unlock(hd);
+    return -1;
+  }
+  if (size_out) *size_out = s->size;
+  int64_t off = static_cast<int64_t>(s->offset);
+  unlock(hd);
+  return off;
+}
+
+int rt_store_free(void* hv, const char* key) {
+  auto* h = static_cast<Handle*>(hv);
+  auto* hd = header_of(h);
+  char* base = static_cast<char*>(h->base);
+  lock(hd);
+  Slot* s = find_slot(hd, key, false);
+  if (!s) {
+    unlock(hd);
+    return -1;
+  }
+  free_list_insert(hd, base, static_cast<int64_t>(s->offset) -
+                                 static_cast<int64_t>(sizeof(FreeBlock)),
+                   align_up(s->size + sizeof(FreeBlock), kAlign));
+  hd->used -= s->size;
+  hd->num_objects--;
+  s->state = kTombstone;
+  unlock(hd);
+  return 0;
+}
+
+uint64_t rt_store_used(void* hv) {
+  return header_of(static_cast<Handle*>(hv))->used;
+}
+
+uint64_t rt_store_num_objects(void* hv) {
+  return header_of(static_cast<Handle*>(hv))->num_objects;
+}
+
+uint64_t rt_store_capacity(void* hv) {
+  return header_of(static_cast<Handle*>(hv))->capacity;
+}
+
+char* rt_store_base(void* hv) {
+  return static_cast<char*>(static_cast<Handle*>(hv)->base);
+}
+
+}  // extern "C"
