@@ -1,36 +1,182 @@
-"""Flagship benchmark: Llama train-step throughput (tokens/sec/chip).
+"""Flagship benchmark: Llama train-step throughput (tokens/sec/chip) + MFU.
 
-Runs fwd+bwd+adamw on a Llama-125M decoder, bf16 activations, on whatever
-backend jax finds (the real TPU chip under the driver; CPU for dev runs).
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} (everything
-else goes to stderr). vs_baseline compares against the newest BENCH_r*.json
-the driver recorded, falling back to 1.0 when this is the first measurement
-(the reference fork publishes no numbers — BASELINE.json "published" is {}).
+Two-process design for resilience (round-1 postmortem: one UNAVAILABLE at
+backend init burned the round's perf slot):
+
+- The parent process is an ORCHESTRATOR that never imports jax. It sweeps
+  stale worker processes / orphaned shm segments that could be holding the
+  chip, then runs `python bench.py --measure --config <name>` children with
+  retry + backoff. A failed TPU-plugin init poisons only the child.
+- The child (`--measure`) does the actual timing and prints one JSON line.
+
+Attempt ladder: llama_1b (bf16 params, remat) -> llama_125m (f32) -> CPU-scrub
+llama_125m, so the round always records SOME number with rc=0. The final JSON
+line is the child's, re-printed verbatim by the orchestrator:
+{"metric", "value", "unit", "vs_baseline", "mfu", "backend", ...}.
+vs_baseline compares against the newest prior BENCH_r*.json with the same
+metric name (the reference fork publishes no numbers — BASELINE.json
+"published" is {} — so our own history is the baseline).
 """
 
+import argparse
 import glob
 import json
 import os
 import re
+import signal
+import subprocess
 import sys
 import time
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+_CONFIGS = {
+    # name -> (batch, seq, timeout_s)
+    "llama_1b": (4, 2048, 1500),
+    "llama_125m": (8, 2048, 600),
+}
 
 
 def _log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def _prior_value(repo_dir):
+# ---------------------------------------------------------------- orchestrator
+
+def _worker_socket_path(pid: int):
+    """worker_main's argv[1] is its controller socket path."""
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            argv = f.read().split(b"\0")
+        i = argv.index(b"ray_tpu._private.worker_main")
+        return argv[i + 1].decode()
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def _controller_alive(sock_path: str) -> bool:
+    import socket as _socket
+    s = _socket.socket(_socket.AF_UNIX, _socket.SOCK_STREAM)
+    s.settimeout(2.0)
+    try:
+        s.connect(sock_path)
+        return True
+    except OSError:
+        return False
+    finally:
+        s.close()
+
+
+def _kill_stale_workers():
+    """Kill ORPHANED ray_tpu worker processes from crashed sessions — a dead
+    session's TPU worker still holds the chip and the next backend init hangs
+    (observed in round 1's rc=124 dryrun). Staleness test: the worker's
+    controller socket (its argv[1]) no longer accepts connections. Workers of
+    a live session are left alone; ppid is NOT used (a container driver can
+    legitimately run as pid 1)."""
+    try:
+        out = subprocess.run(["pgrep", "-f", "ray_tpu._private.worker_main"],
+                             capture_output=True, text=True).stdout
+    except FileNotFoundError:
+        return
+    for pid in out.split():
+        try:
+            pid = int(pid)
+            if pid == os.getpid():
+                continue
+            sock = _worker_socket_path(pid)
+            if sock is not None and _controller_alive(sock):
+                continue  # controller answering → live session
+            _log(f"bench: killing stale worker pid={pid} (socket={sock})")
+            os.kill(pid, signal.SIGKILL)
+        except (ValueError, ProcessLookupError, PermissionError):
+            pass
+
+
+def _mapped_shm_segments():
+    """Names under /dev/shm currently mmapped by ANY process (via
+    /proc/*/maps) — these belong to live sessions. mtime is useless here
+    (mmap writes don't touch it), so mapping state is the ground truth."""
+    mapped = set()
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/maps") as f:
+                for line in f:
+                    i = line.find("/dev/shm/rtpu-")
+                    if i >= 0:
+                        mapped.add(line[i + len("/dev/shm/"):].split()[0])
+        except OSError:
+            continue
+    return mapped
+
+
+def _any_live_session() -> bool:
+    """Any controller socket (tempdir rtpu-*.sock) still accepting?"""
+    import glob as _glob
+    import tempfile
+    for sock in _glob.glob(os.path.join(tempfile.gettempdir(), "rtpu-*.sock")):
+        if _controller_alive(sock):
+            return True
+    return False
+
+
+def _sweep_orphan_shm():
+    """Remove /dev/shm/rtpu-* segments that are demonstrably orphaned:
+    arena names embed the creator pid (rtpu-arena-<pid>-<id>) → removed when
+    that pid is dead; anything still mmapped by a live process is kept; and
+    per-object segments (no owner id in the name, may legitimately sit
+    unmapped between put and get) are swept only when NO live session exists
+    on the machine at all."""
+    try:
+        names = os.listdir("/dev/shm")
+    except OSError:
+        return
+    mapped = _mapped_shm_segments()
+    live_session = _any_live_session()
+    for name in names:
+        if not name.startswith("rtpu-") or name in mapped:
+            continue
+        path = os.path.join("/dev/shm", name)
+        m = re.match(r"rtpu-arena-(\d+)-", name)
+        if m:
+            pid = int(m.group(1))
+            try:
+                os.kill(pid, 0)
+                continue  # creator alive; leave it
+            except ProcessLookupError:
+                pass
+            except PermissionError:
+                continue
+        elif live_session:
+            continue  # could be a live session's unmapped object
+        try:
+            os.unlink(path)
+            _log(f"bench: removed orphan shm segment {name}")
+        except OSError:
+            pass
+
+
+def _prior_value(metric):
     best = None
-    for path in glob.glob(os.path.join(repo_dir, "BENCH_r*.json")):
+    for path in glob.glob(os.path.join(REPO, "BENCH_r*.json")):
         m = re.search(r"BENCH_r(\d+)\.json$", path)
         if not m:
             continue
         try:
             with open(path) as f:
                 rec = json.load(f)
-            val = float(rec.get("value"))
         except Exception:  # noqa: BLE001 - malformed prior record
+            continue
+        # the driver wraps our JSON line under "parsed"; accept both layouts
+        parsed = rec.get("parsed") if isinstance(rec.get("parsed"), dict) else rec
+        try:
+            if parsed.get("metric") != metric:
+                continue
+            val = float(parsed["value"])
+        except (KeyError, TypeError, ValueError):
             continue
         rnd = int(m.group(1))
         if best is None or rnd > best[0]:
@@ -38,21 +184,89 @@ def _prior_value(repo_dir):
     return None if best is None else best[1]
 
 
-def main():
+def _run_child(config, cpu_scrub=False):
+    """Run one measurement child; returns the parsed JSON dict or None."""
+    env = dict(os.environ)
+    if cpu_scrub:
+        from ray_tpu.util.tpu import scrub_accel_env
+        env = scrub_accel_env(env)
+    timeout = _CONFIGS[config][2] if not cpu_scrub else 300
+    cmd = [sys.executable, os.path.abspath(__file__), "--measure",
+           "--config", config]
+    _log(f"bench: attempt config={config} cpu_scrub={cpu_scrub} "
+         f"timeout={timeout}s")
+    try:
+        r = subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        _log(f"bench: child timed out ({timeout}s)")
+        return None
+    sys.stderr.write(r.stderr[-4000:])
+    if r.returncode != 0:
+        _log(f"bench: child rc={r.returncode}, stdout tail: {r.stdout[-500:]}")
+        return None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError:
+            continue
+    _log("bench: child produced no JSON line")
+    return None
+
+
+def orchestrate():
+    _kill_stale_workers()
+    _sweep_orphan_shm()
+    # ladder: (config, cpu_scrub, retries)
+    ladder = [("llama_1b", False, 2), ("llama_125m", False, 2),
+              ("llama_125m", True, 1)]
+    result = None
+    for config, scrub, retries in ladder:
+        for attempt in range(retries):
+            result = _run_child(config, cpu_scrub=scrub)
+            if result is not None:
+                break
+            backoff = 20 * (attempt + 1)
+            _log(f"bench: retrying after {backoff}s")
+            time.sleep(backoff)
+        if result is not None:
+            break
+    if result is None:
+        _log("bench: all attempts failed")
+        sys.exit(1)
+    prior = _prior_value(result["metric"])
+    result["vs_baseline"] = round(result["value"] / prior, 3) if prior else 1.0
+    print(json.dumps(result))
+
+
+# ---------------------------------------------------------------- measurement
+
+def measure(config_name):
     import jax
     import jax.numpy as jnp
     import optax
 
-    from ray_tpu.models.llama import (Llama, LlamaConfig,
-                                      llama_compute_flops)
+    from ray_tpu.models.llama import (Llama, LlamaConfig, llama_compute_flops,
+                                      llama_param_count)
     from ray_tpu.ops.losses import cross_entropy
+    from ray_tpu.util import tpu as tpu_util
 
     backend = jax.default_backend()
     on_tpu = backend not in ("cpu",)
-    batch, seq = (8, 2048) if on_tpu else (2, 256)
-    cfg = LlamaConfig.llama_125m(max_seq_len=seq)
+    batch, seq, _ = _CONFIGS[config_name]
+    if not on_tpu:
+        batch, seq = 2, 256
+    if config_name == "llama_1b":
+        # bf16 params + remat: ~0.9B params -> 1.7G params + 1.7G grads +
+        # 3.4G adam (mu/nu mirror param dtype) fits a 16G v5e chip.
+        cfg = LlamaConfig.llama_1b(max_seq_len=seq, param_dtype=jnp.bfloat16,
+                                   remat=True)
+    else:
+        cfg = LlamaConfig.llama_125m(max_seq_len=seq)
     model = Llama(cfg)
-    _log(f"backend={backend} devices={len(jax.devices())} batch={batch} seq={seq}")
+    n_params = llama_param_count(cfg)
+    _log(f"backend={backend} devices={len(jax.devices())} config={config_name}"
+         f" params={n_params/1e6:.0f}M batch={batch} seq={seq}")
 
     key = jax.random.PRNGKey(0)
     tokens = jax.random.randint(key, (batch, seq + 1), 0, cfg.vocab_size)
@@ -93,20 +307,37 @@ def main():
     tps = tokens_per_step * steps / dt
     n_chips = max(len(jax.devices()), 1)
     tps_chip = tps / n_chips
-    flops = llama_compute_flops(cfg, batch, seq) * steps / dt
-    _log(f"{tps_chip:,.0f} tokens/s/chip, {flops/1e12:.2f} TFLOP/s "
+    flops_per_sec = llama_compute_flops(cfg, batch, seq) * steps / dt
+    peak = tpu_util.peak_flops_per_chip() if on_tpu else None
+    mfu = (flops_per_sec / (n_chips * peak)) if peak else None
+    _log(f"{tps_chip:,.0f} tokens/s/chip, {flops_per_sec/1e12:.2f} TFLOP/s, "
+         f"mfu={mfu if mfu is None else round(mfu, 3)} "
          f"({dt/steps*1e3:.1f} ms/step, loss={final_loss:.3f})")
 
-    repo_dir = os.path.dirname(os.path.abspath(__file__))
-    prior = _prior_value(repo_dir)
-    vs = tps_chip / prior if prior else 1.0
+    # backend is part of the metric name so vs_baseline never compares a
+    # CPU-fallback number against a TPU history (phantom 99% regressions)
+    backend_tag = "" if on_tpu else "_cpu"
     print(json.dumps({
-        "metric": "llama125m_train_tokens_per_sec_per_chip",
+        "metric": f"{config_name}_train_tokens_per_sec_per_chip{backend_tag}",
         "value": round(tps_chip, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": 1.0,  # orchestrator rewrites against history
+        "mfu": None if mfu is None else round(mfu, 4),
+        "tflops_per_sec": round(flops_per_sec / 1e12, 2),
+        "backend": backend,
+        "params_m": round(n_params / 1e6),
+        "batch": batch, "seq": seq,
+        "ms_per_step": round(dt / steps * 1e3, 1),
+        "loss": round(final_loss, 3),
     }))
 
 
 if __name__ == "__main__":
-    main()
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--measure", action="store_true")
+    ap.add_argument("--config", default="llama_1b", choices=sorted(_CONFIGS))
+    args = ap.parse_args()
+    if args.measure:
+        measure(args.config)
+    else:
+        orchestrate()

@@ -314,8 +314,16 @@ class Controller:
         for oid in result_oids:
             self.objects[oid] = ObjectMeta(object_id=oid, creating_task=spec.task_id)
             self.object_events[oid] = asyncio.Event()
+        retries = spec.max_retries
+        if spec.actor_id and not spec.is_actor_creation and retries == 0:
+            # actor method retries come from the actor's max_task_retries
+            # (ref: ray actor fault tolerance; -1 = unlimited)
+            actor = self.actors.get(spec.actor_id)
+            if actor is not None and actor.options is not None:
+                mtr = actor.options.max_task_retries
+                retries = (1 << 30) if mtr == -1 else mtr
         rec = TaskRecord(spec=spec, result_oids=result_oids,
-                         retries_left=spec.max_retries, ts_submit=time.time())
+                        retries_left=retries, ts_submit=time.time())
         self.tasks[spec.task_id] = rec
         # dependency tracking: top-level ref args must be local before dispatch.
         # Pin every ref arg for the task's lifetime so caller-side GC of the
@@ -481,15 +489,24 @@ class Controller:
                 self._spawn_worker(tpu_capable=True)
 
     # env vars that bind a process to the accelerator runtime; stripped for
-    # CPU-only workers (see WorkerConn.tpu_capable)
-    _TPU_ENV_KEYS = ("PALLAS_AXON_POOL_IPS", "TPU_WORKER_HOSTNAMES",
-                     "PALLAS_AXON_TPU_GEN")
+    # CPU-only workers (see WorkerConn.tpu_capable). Single source of truth:
+    # ray_tpu/util/tpu.py (shared with bench.py / __graft_entry__).
+    from ..util.tpu import ACCEL_ENV_KEYS as _TPU_ENV_KEYS
 
     def _spawn_worker(self, actor: ActorRecord = None,
                       tpu_capable: bool = False) -> WorkerConn:
         wid = ids.worker_id()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = wid
+        # Propagate the driver's sys.path so by-reference cloudpickle (module
+        # -level fns/classes) resolves in workers even when the driver added
+        # path entries at runtime (pytest rootdir insertion, scripts mutating
+        # sys.path) — the reference assumes identical envs across the cluster.
+        extra = [p if p else os.getcwd() for p in sys.path
+                 if p == "" or os.path.isdir(p)]
+        if extra:
+            env["PYTHONPATH"] = os.pathsep.join(
+                extra + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
         if actor is not None:
             tpu_capable = (actor.creation_spec is not None and
                            actor.creation_spec.resources.get("TPU", 0) > 0)

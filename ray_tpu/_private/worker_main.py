@@ -32,13 +32,23 @@ class WorkerState:
         self.actor_id = None
         self.fn_cache = {}
         self.async_loop = None
+        self._loop_lock = threading.Lock()
         self.current = threading.local()
 
     def get_async_loop(self):
+        # Double-checked under a lock: the first two calls of an async actor
+        # routinely arrive on two pool threads at once (e.g. two collective
+        # ranks hitting a rendezvous actor). An unguarded check-then-create
+        # spawned TWO event loops, splitting the actor's coroutines across
+        # loops — asyncio.Event.set() on one loop never wakes a waiter on
+        # the other, which surfaced as the host-collective deadlock (r1).
         if self.async_loop is None:
-            self.async_loop = asyncio.new_event_loop()
-            t = threading.Thread(target=self.async_loop.run_forever, daemon=True)
-            t.start()
+            with self._loop_lock:
+                if self.async_loop is None:
+                    loop = asyncio.new_event_loop()
+                    t = threading.Thread(target=loop.run_forever, daemon=True)
+                    t.start()
+                    self.async_loop = loop
         return self.async_loop
 
 
@@ -150,6 +160,11 @@ def _emit(ws, spec, item):
 
 
 def main():
+    # SIGUSR1 → dump all thread stacks to stderr (ref: ray's faulthandler
+    # setup in default_worker.py); invaluable for hung-worker debugging
+    import faulthandler
+    import signal
+    faulthandler.register(signal.SIGUSR1, all_threads=True)
     socket_path, worker_id = sys.argv[1], sys.argv[2]
     client = WorkerClient(socket_path, worker_id)
     state.set_global_client(client)

@@ -5,13 +5,15 @@ Deployments are async replica actors; handles route with power-of-two-choices;
 adds a continuous-batching LLM replica on a jitted decode step.
 """
 
-from .api import delete, get_deployment_handle, run, shutdown, status
+from .api import delete, get_deployment_handle, run, shutdown, start, status
 from .batching import batch
 from .deployment import AutoscalingConfig, Deployment, DeploymentConfig, deployment
 from .handle import DeploymentHandle, DeploymentResponse
+from .proxy import Request, Response
 
 __all__ = [
     "AutoscalingConfig", "Deployment", "DeploymentConfig", "DeploymentHandle",
-    "DeploymentResponse", "batch", "delete", "deployment",
-    "get_deployment_handle", "run", "shutdown", "status",
+    "DeploymentResponse", "Request", "Response", "batch", "delete",
+    "deployment", "get_deployment_handle", "run", "shutdown", "start",
+    "status",
 ]

@@ -43,9 +43,31 @@ def run(target: BoundDeployment, *, name: str = "default",
         handles[id(node)] = DeploymentHandle(dep.name, name)
         any_autoscaling = any_autoscaling or dep.config.autoscaling_config
 
+    # HTTP route: prefix → the root (ingress) deployment of this app
+    from .proxy import ingress_is_streaming
+    ingress = target.deployment
+    prefix = route_prefix if route_prefix is not None else (
+        "/" if name == "default" else f"/{name}")
+    ray_tpu.get(ctrl.set_route.remote(
+        prefix, name, ingress.name, ingress_is_streaming(ingress._callable)))
+
     if any_autoscaling and _autoscale_interval_s:
         ray_tpu.get(ctrl.start_autoscaler.remote(_autoscale_interval_s))
     return handles[id(target)]
+
+
+def start(detached: bool = True, http_options: Optional[Dict] = None,
+          **_compat):
+    """Start the HTTP proxy (reference: serve.start). Returns the bound port
+    — pass port=0 in http_options to grab an ephemeral one (test-friendly)."""
+    from .proxy import start_proxy
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    opts = dict(http_options or {})
+    _proxy, port = start_proxy(opts.get("host", "127.0.0.1"),
+                               opts.get("port", 8000))
+    return port
 
 
 def delete(name: str = "default") -> None:
@@ -68,6 +90,13 @@ def shutdown() -> None:
     import ray_tpu
     if not ray_tpu.is_initialized():
         return
+    try:
+        from .proxy import PROXY_NAME
+        proxy = ray_tpu.get_actor(PROXY_NAME)
+        ray_tpu.get(proxy.drain.remote(), timeout=15)
+        ray_tpu.kill(proxy)
+    except Exception:  # noqa: BLE001 - no proxy running
+        pass
     try:
         ctrl = get_controller()
         for app in ray_tpu.get(ctrl.list_apps.remote()):

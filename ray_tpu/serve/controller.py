@@ -16,6 +16,8 @@ class ServeController:
         # {app: {deployment: {"replicas": [handles], "config": DeploymentConfig,
         #        "blob": bytes, "init": (args, kwargs), "version": int}}}
         self.apps: Dict[str, Dict[str, Dict]] = {}
+        # route prefix -> (app, ingress deployment, is_streaming)
+        self.routes: Dict[str, tuple] = {}
         self._autoscale_task = None
 
     # -- registry ------------------------------------------------------------
@@ -38,8 +40,23 @@ class ServeController:
     def list_apps(self) -> List[str]:
         return list(self.apps)
 
+    def set_route(self, prefix: str, app: str, ingress: str,
+                  is_streaming: bool = False) -> None:
+        held_by = self.routes.get(prefix)
+        if held_by is not None and held_by[0] != app:
+            raise ValueError(
+                f"route_prefix '{prefix}' is already used by app "
+                f"'{held_by[0]}'; pick a different prefix or delete that app")
+        # one route per app: re-registering moves the prefix
+        self.routes = {p: t for p, t in self.routes.items() if t[0] != app}
+        self.routes[prefix] = (app, ingress, is_streaming)
+
+    def get_routes(self) -> Dict[str, tuple]:
+        return dict(self.routes)
+
     def delete_app(self, app: str) -> None:
         import ray_tpu
+        self.routes = {p: t for p, t in self.routes.items() if t[0] != app}
         for name, rec in self.apps.pop(app, {}).items():
             for h in rec["replicas"]:
                 try:

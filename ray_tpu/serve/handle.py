@@ -30,20 +30,37 @@ class DeploymentResponse:
 
 
 class DeploymentResponseGenerator:
-    """Streaming response: iterate results as the replica yields them."""
+    """Streaming response: iterate results as the replica yields them.
+    `on_finish` runs exactly once when the stream ends (exhausted, errored,
+    or GC'd) — the handle uses it to decrement its in-flight counter."""
 
-    def __init__(self, gen):
+    def __init__(self, gen, on_finish=None):
         self._gen = gen
+        self._on_finish = on_finish
+
+    def _finish(self):
+        cb, self._on_finish = self._on_finish, None
+        if cb is not None:
+            cb()
 
     def __iter__(self):
         import ray_tpu
-        for ref in self._gen:
-            yield ray_tpu.get(ref)
+        try:
+            for ref in self._gen:
+                yield ray_tpu.get(ref)
+        finally:
+            self._finish()
 
     async def __aiter__(self):
         import ray_tpu
-        async for ref in self._gen:
-            yield await ref
+        try:
+            async for ref in self._gen:
+                yield await ref
+        finally:
+            self._finish()
+
+    def __del__(self):
+        self._finish()
 
 
 class DeploymentHandle:
@@ -55,7 +72,9 @@ class DeploymentHandle:
         self._stream = stream
         self._replicas: List = []
         self._inflight: Dict[str, int] = {}
-        self._lock = threading.Lock()
+        # reentrant: stream-generator __del__ fires the decrement callback,
+        # and cyclic GC can run while this thread already holds the lock
+        self._lock = threading.RLock()
         self._version = -1
         self._last_refresh = 0.0
 
@@ -121,7 +140,7 @@ class DeploymentHandle:
         if self._stream:
             gen = replica.handle_request_streaming.options(
                 num_returns="streaming").remote(self._method_name, *args, **kwargs)
-            return DeploymentResponseGenerator(gen)
+            return DeploymentResponseGenerator(gen, on_finish=lambda: _done(None))
         ref = replica.handle_request.remote(self._method_name, *args, **kwargs)
         try:
             ref.future().add_done_callback(_done)

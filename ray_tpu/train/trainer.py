@@ -7,24 +7,23 @@ processes and wires NCCL between them. TPU-native, one Python process per
 host drives all local chips as one SPMD program — so on a single host the
 train loop runs exactly once and all parallelism lives inside the jitted step
 (mesh axes dp/fsdp/tp/...). `num_workers > 1` is the multi-host (DCN)
-dimension: every host runs the same `fit()` under `jax.distributed`, and
-world rank/size come from `jax.process_index()/process_count()`.
+dimension: every host runs the same `fit()` under `jax.distributed`, and a
+declared multi-worker run without a live jax process world is an ERROR, not
+a silent world-of-1 (round-1 weakness).
 
-Fault tolerance: `FailureConfig(max_failures=k)` re-runs the loop up to k
-times, restoring the last reported checkpoint into the session — the
-reference restarts dead workers from the Trial's checkpoint the same way
-(python/ray/train/_internal/worker_group.py restart path).
+Orchestration: when a ray_tpu runtime is up, the loop runs inside a
+restartable TrainWorker actor (chip-bound via num_tpus, respawned by the
+controller on crash, resuming from the newest on-disk checkpoint — see
+worker_group.py). Without a runtime it runs in-process with the same
+code path.
 """
 
 import dataclasses
-import os
-import shutil
-import traceback
 from typing import Any, Callable, Dict, List, Optional
 
-from . import session as _session
-from .checkpoint import Checkpoint, _CheckpointBook
+from .checkpoint import Checkpoint
 from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .worker_group import TrainWorker, run_training
 
 
 @dataclasses.dataclass
@@ -43,21 +42,6 @@ class Result:
         return pd.DataFrame(self.metrics_history)
 
 
-def _world_info(scaling: ScalingConfig):
-    """(world_size, world_rank) — multi-host comes from jax.distributed."""
-    if scaling.num_workers <= 1:
-        return 1, 0
-    try:
-        import jax
-        if jax.process_count() > 1:
-            return jax.process_count(), jax.process_index()
-    except Exception:  # noqa: BLE001 - jax not initialized for multi-host
-        pass
-    # Declared multi-worker but single-process: treat as world of 1 so the
-    # loop still runs (dry-run / test mode); mesh axes provide parallelism.
-    return 1, 0
-
-
 class JaxTrainer:
     """Runs `train_loop_per_worker(config)` under a train session.
 
@@ -65,6 +49,9 @@ class JaxTrainer:
       `ray_tpu.train.report(...)` to emit metrics/checkpoints.
     datasets: {name: Dataset-or-iterable} surfaced via
       `train.get_dataset_shard(name)`.
+    use_worker_actor: run the loop in a restartable TPU actor. Default:
+      yes when a ray_tpu runtime is initialized (reference behavior — Train
+      always runs workers as actors), in-process otherwise.
     """
 
     def __init__(
@@ -76,6 +63,7 @@ class JaxTrainer:
         run_config: Optional[RunConfig] = None,
         datasets: Optional[Dict[str, Any]] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
+        use_worker_actor: Optional[bool] = None,
     ):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config or {}
@@ -83,98 +71,70 @@ class JaxTrainer:
         self.run_config = run_config or RunConfig()
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint
+        self.use_worker_actor = use_worker_actor
 
-    # -- internals ---------------------------------------------------------
-    def _call_loop(self):
-        import inspect
-        sig = inspect.signature(self.train_loop)
-        if len(sig.parameters) == 0:
-            return self.train_loop()
-        return self.train_loop(self.train_loop_config)
-
-    def _should_stop(self, metrics: Dict[str, Any]) -> bool:
-        stop = self.run_config.stop
-        if not stop:
+    def _in_actor(self) -> bool:
+        if self.use_worker_actor is not None:
+            return self.use_worker_actor
+        try:
+            import ray_tpu
+            return ray_tpu.is_initialized()
+        except Exception:  # noqa: BLE001 - core not importable
             return False
-        if callable(stop):
-            return bool(stop(metrics))
-        for key, threshold in stop.items():
-            if key in metrics and metrics[key] >= threshold:
-                return True
-        return False
 
     def fit(self) -> Result:
-        run_cfg = self.run_config
-        exp_dir = run_cfg.experiment_dir()
-        ckpt_cfg = run_cfg.checkpoint_config or CheckpointConfig()
-        fail_cfg = run_cfg.failure_config or FailureConfig()
-        book = _CheckpointBook(ckpt_cfg)
-        world_size, world_rank = _world_info(self.scaling_config)
-
-        history: List[Dict[str, Any]] = []
-        last_metrics: Dict[str, Any] = {}
-        ckpt_counter = [0]
-
-        def report_fn(metrics: Dict[str, Any], ckpt: Optional[Checkpoint]):
-            metrics = dict(metrics)
-            metrics.setdefault("training_iteration", len(history) + 1)
-            history.append(metrics)
-            last_metrics.clear()
-            last_metrics.update(metrics)
-            if ckpt is not None and world_rank == 0:
-                # Persist under the experiment dir (reference: trial dir).
-                dst = os.path.join(exp_dir,
-                                   f"checkpoint_{ckpt_counter[0]:06d}")
-                ckpt_counter[0] += 1
-                if os.path.abspath(ckpt.path) != os.path.abspath(dst):
-                    if os.path.exists(dst):
-                        shutil.rmtree(dst)
-                    shutil.copytree(ckpt.path, dst)
-                    ckpt = Checkpoint(dst)
-                ckpt.update_metadata({"iteration": metrics["training_iteration"]})
-                book.register(ckpt, metrics)
-            sess = _session._get_session()
-            sess.checkpoint = book.latest or sess.checkpoint
-            if self._should_stop(metrics):
-                sess.stop_requested = True
-
-        start_ckpt = self.resume_from_checkpoint
-        attempts = 0
-        error: Optional[BaseException] = None
-        while True:
-            ctx = _session.TrainContext(
-                world_size=world_size, world_rank=world_rank,
-                local_rank=world_rank, local_world_size=1,
-                node_rank=world_rank,
-                experiment_name=run_cfg.name or "experiment",
-                trial_name=run_cfg.name or "experiment",
-                trial_id="train_0", trial_dir=exp_dir)
-            _session.init_session(ctx, checkpoint=book.latest or start_ckpt,
-                                  report_fn=report_fn,
-                                  dataset_shards=self.datasets)
-            try:
-                self._call_loop()
-                error = None
-                break
-            except _session.TrainingStopped:
-                error = None
-                break
-            except Exception as e:  # noqa: BLE001 - retried per FailureConfig
-                error = e
-                attempts += 1
-                limit = fail_cfg.max_failures
-                if limit == -1 or attempts <= limit:
-                    traceback.print_exc()
-                    continue
-                break
-            finally:
-                _session.shutdown_session()
-
+        import uuid
+        resume_path = (self.resume_from_checkpoint.path
+                       if self.resume_from_checkpoint else None)
+        # one id per logical fit(): an actor RESTART re-runs with the same id
+        # and resumes; a different fit() on the same dir starts fresh
+        run_id = uuid.uuid4().hex
+        if self._in_actor():
+            out = self._fit_in_actor(resume_path, run_id)
+        else:
+            out = run_training(self.train_loop, self.train_loop_config,
+                               self.scaling_config, self.run_config,
+                               self.datasets, resume_path, run_id=run_id)
         return Result(
-            metrics=dict(last_metrics) or None,
-            checkpoint=book.latest or start_ckpt,
-            error=error,
-            path=exp_dir,
-            metrics_history=history,
-            best_checkpoints=[(c, s) for s, _, c in book.entries],
+            metrics=out["metrics"],
+            checkpoint=Checkpoint(out["latest_ckpt"]) if out["latest_ckpt"] else None,
+            error=out["error"],
+            path=out["path"],
+            metrics_history=out["history"],
+            best_checkpoints=[(Checkpoint(p), s) for p, s in out["best_ckpts"]],
         )
+
+    def _fit_in_actor(self, resume_path: Optional[str],
+                      run_id: Optional[str] = None) -> Dict[str, Any]:
+        """Launch the TrainWorker actor and await its run() — crashes respawn
+        the actor (max_restarts) and re-run the task (max_task_retries), each
+        attempt resuming from the newest on-disk checkpoint."""
+        import cloudpickle
+
+        import ray_tpu
+
+        fail_cfg = self.run_config.failure_config or FailureConfig()
+        limit = fail_cfg.max_failures
+        restarts = -1 if limit == -1 else max(limit, 0)
+        opts: Dict[str, Any] = {"max_restarts": restarts,
+                                "max_task_retries": restarts,
+                                "num_cpus": 0}
+        if self.scaling_config.use_tpu:
+            opts["num_tpus"] = self.scaling_config.chips_per_worker or 1
+        if self.scaling_config.resources_per_worker:
+            opts["resources"] = dict(self.scaling_config.resources_per_worker)
+        Worker = ray_tpu.remote(**opts)(TrainWorker)
+        worker = Worker.remote(
+            cloudpickle.dumps(self.train_loop), self.train_loop_config,
+            self.scaling_config, self.run_config, self.datasets, resume_path,
+            run_id)
+        try:
+            return ray_tpu.get(worker.run.remote())
+        except Exception as e:  # noqa: BLE001 - actor died beyond retries
+            from .worker_group import result_after_worker_death
+            return result_after_worker_death(self.run_config, e, resume_path)
+        finally:
+            try:
+                ray_tpu.kill(worker)
+            except Exception:  # noqa: BLE001 - already dead
+                pass

@@ -1,0 +1,295 @@
+"""Train worker orchestration (reference:
+python/ray/train/v2/_internal/execution/worker_group/worker_group.py, 1131
+lines of process-group lifecycle + health polling).
+
+TPU re-design: the reference launches `num_workers` DDP processes per trial
+and wires NCCL between them; on TPU one *worker actor* per host drives all
+local chips as a single SPMD program, so a single-host trainer needs exactly
+one TPU-bound actor. Fault tolerance composes from runtime primitives instead
+of a bespoke health-poll loop: the actor has `max_restarts`/`max_task_retries`
+so a crashed worker process is respawned by the controller and the `run()`
+call re-executes, and `run()` always resumes from the newest on-disk
+checkpoint in the experiment dir — the same restart-from-Trial-checkpoint
+semantics, minus the coordinator.
+
+Multi-host (`num_workers > 1`) is the DCN axis: every host runs fit() under
+`jax.distributed` (see parallel/distributed.py) and this module validates the
+world actually exists instead of silently training on 1/N of the requested
+compute (round-1 weakness #6).
+"""
+
+import json
+import os
+import re
+import traceback
+from typing import Any, Callable, Dict, Optional
+
+from . import session as _session
+from .checkpoint import Checkpoint, _CheckpointBook
+from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+
+_PROGRESS_FILE = "progress.jsonl"
+_RUN_ID_FILE = ".run_id"
+_CKPT_RE = re.compile(r"^checkpoint_(\d+)$")
+
+
+def _claim_run_dir(exp_dir: str, run_id: Optional[str]) -> bool:
+    """Returns True when this call CONTINUES the run that owns exp_dir (same
+    run_id → actor restart / retry → resume from its checkpoints). A
+    different or absent run_id claims the dir fresh: prior checkpoints stay
+    on disk (their indices are skipped) but are not auto-resumed — a new
+    fit() must not silently pick up some earlier run's state."""
+    if run_id is None:
+        return True  # legacy caller: keep resume-from-dir behavior
+    path = os.path.join(exp_dir, _RUN_ID_FILE)
+    try:
+        with open(path) as f:
+            if f.read().strip() == run_id:
+                return True
+    except OSError:
+        pass
+    with open(path, "w") as f:
+        f.write(run_id)
+    # fresh claim: history restarts (file truncated), book starts empty
+    try:
+        os.remove(os.path.join(exp_dir, _PROGRESS_FILE))
+    except OSError:
+        pass
+    return False
+
+
+def rebuild_book(exp_dir: str, ckpt_cfg) -> tuple:
+    """Reconstruct checkpoint bookkeeping from the experiment dir so a
+    restarted worker resumes where the dead one left off. Returns
+    (book, next_checkpoint_index)."""
+    book = _CheckpointBook(ckpt_cfg)
+    entries = []
+    if os.path.isdir(exp_dir):
+        for name in os.listdir(exp_dir):
+            m = _CKPT_RE.match(name)
+            if m:
+                entries.append((int(m.group(1)), name))
+    for _idx, name in sorted(entries):
+        ckpt = Checkpoint(os.path.join(exp_dir, name))
+        meta = ckpt.get_metadata()
+        book.register(ckpt, meta.get("metrics") or {})
+    next_idx = max((i for i, _ in entries), default=-1) + 1
+    return book, next_idx
+
+
+def load_history(exp_dir: str) -> list:
+    path = os.path.join(exp_dir, _PROGRESS_FILE)
+    out = []
+    if os.path.exists(path):
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except json.JSONDecodeError:
+                        pass  # torn write from a killed worker
+    return out
+
+
+def _append_history(exp_dir: str, metrics: Dict) -> None:
+    try:
+        with open(os.path.join(exp_dir, _PROGRESS_FILE), "a") as f:
+            f.write(json.dumps(metrics, default=str) + "\n")
+    except OSError:
+        pass
+
+
+def run_training(train_loop: Callable, train_loop_config: Dict,
+                 scaling: ScalingConfig, run_cfg: RunConfig,
+                 datasets: Dict[str, Any],
+                 resume_ckpt_path: Optional[str],
+                 stop_fn: Optional[Callable] = None,
+                 run_id: Optional[str] = None) -> Dict[str, Any]:
+    """The train-loop driver: runs `train_loop` under a session with
+    report/checkpoint plumbing, retrying per FailureConfig. Runs either
+    in-process (no runtime) or inside a TrainWorker actor. Returns a
+    picklable result dict; Checkpoints travel as paths.
+
+    `run_id` scopes disk state to ONE logical fit(): a re-invocation with the
+    same id (actor restart) resumes from the dir's checkpoints; a different
+    id starts fresh instead of adopting a previous run's state."""
+    exp_dir = run_cfg.experiment_dir()
+    ckpt_cfg = run_cfg.checkpoint_config or CheckpointConfig()
+    fail_cfg = run_cfg.failure_config or FailureConfig()
+    resuming = _claim_run_dir(exp_dir, run_id)
+    book, next_idx = rebuild_book(exp_dir, ckpt_cfg)
+    if not resuming:
+        book = _CheckpointBook(ckpt_cfg)  # prior ckpts stay but aren't ours
+    world_size, world_rank = _world_info(scaling)
+
+    history = load_history(exp_dir) if resuming else []
+    last_metrics: Dict[str, Any] = dict(history[-1]) if history else {}
+    ckpt_counter = [next_idx]
+
+    def _should_stop(metrics: Dict[str, Any]) -> bool:
+        stop = run_cfg.stop
+        if stop:
+            if callable(stop):
+                if stop(metrics):
+                    return True
+            else:
+                for key, threshold in stop.items():
+                    if key in metrics and metrics[key] >= threshold:
+                        return True
+        return bool(stop_fn and stop_fn(metrics))
+
+    def report_fn(metrics: Dict[str, Any], ckpt: Optional[Checkpoint]):
+        import shutil
+        metrics = dict(metrics)
+        metrics.setdefault("training_iteration", len(history) + 1)
+        history.append(metrics)
+        _append_history(exp_dir, metrics)
+        last_metrics.clear()
+        last_metrics.update(metrics)
+        if ckpt is not None and world_rank == 0:
+            dst = os.path.join(exp_dir, f"checkpoint_{ckpt_counter[0]:06d}")
+            ckpt_counter[0] += 1
+            if os.path.abspath(ckpt.path) != os.path.abspath(dst):
+                if os.path.exists(dst):
+                    shutil.rmtree(dst)
+                shutil.copytree(ckpt.path, dst)
+                ckpt = Checkpoint(dst)
+            ckpt.update_metadata({"iteration": metrics["training_iteration"],
+                                  "metrics": _jsonable(metrics)})
+            book.register(ckpt, metrics)
+        sess = _session._get_session()
+        sess.checkpoint = book.latest or sess.checkpoint
+        if _should_stop(metrics):
+            sess.stop_requested = True
+
+    def _call_loop():
+        import inspect
+        sig = inspect.signature(train_loop)
+        if len(sig.parameters) == 0:
+            return train_loop()
+        return train_loop(train_loop_config)
+
+    start_ckpt = Checkpoint(resume_ckpt_path) if resume_ckpt_path else None
+    attempts = 0
+    error: Optional[BaseException] = None
+    error_tb = None
+    while True:
+        ctx = _session.TrainContext(
+            world_size=world_size, world_rank=world_rank,
+            local_rank=world_rank, local_world_size=1,
+            node_rank=world_rank,
+            experiment_name=run_cfg.name or "experiment",
+            trial_name=run_cfg.name or "experiment",
+            trial_id="train_0", trial_dir=exp_dir)
+        _session.init_session(ctx, checkpoint=book.latest or start_ckpt,
+                              report_fn=report_fn,
+                              dataset_shards=datasets)
+        try:
+            _call_loop()
+            error = error_tb = None
+            break
+        except _session.TrainingStopped:
+            error = error_tb = None
+            break
+        except Exception as e:  # noqa: BLE001 - retried per FailureConfig
+            error = e
+            error_tb = traceback.format_exc()
+            attempts += 1
+            limit = fail_cfg.max_failures
+            if limit == -1 or attempts <= limit:
+                traceback.print_exc()
+                continue
+            break
+        finally:
+            _session.shutdown_session()
+
+    return _result_dict(exp_dir, book, history, error, error_tb,
+                        fallback_ckpt=start_ckpt.path if start_ckpt else None)
+
+
+def _result_dict(exp_dir: str, book, history, error, error_tb,
+                 fallback_ckpt: Optional[str] = None) -> Dict[str, Any]:
+    """The run_training return contract — sole constructor, so every caller
+    (including trainer's actor-death fallback) stays in sync."""
+    return {
+        "metrics": dict(history[-1]) if history else None,
+        "history": history,
+        "latest_ckpt": book.latest.path if book.latest else fallback_ckpt,
+        "best_ckpts": [(c.path, s) for s, _, c in book.entries],
+        "error": error,
+        "error_tb": error_tb,
+        "path": exp_dir,
+    }
+
+
+def result_after_worker_death(run_cfg: RunConfig, error,
+                              resume_path: Optional[str]) -> Dict[str, Any]:
+    """Build a result from on-disk state when the worker actor died beyond
+    its restart budget (the driver never received run()'s return)."""
+    import traceback as _tb
+    exp_dir = run_cfg.experiment_dir()
+    book, _ = rebuild_book(exp_dir, run_cfg.checkpoint_config
+                           or CheckpointConfig())
+    return _result_dict(exp_dir, book, load_history(exp_dir), error,
+                        _tb.format_exc(), fallback_ckpt=resume_path)
+
+
+def _jsonable(metrics: Dict) -> Dict:
+    out = {}
+    for k, v in metrics.items():
+        try:
+            json.dumps(v)
+            out[k] = v
+        except (TypeError, ValueError):
+            out[k] = str(v)
+    return out
+
+
+def _world_info(scaling: ScalingConfig):
+    """(world_size, world_rank). Multi-host comes from jax.distributed; a
+    declared multi-worker run without a live jax.distributed world is an
+    ERROR (round-1: it silently trained on 1/N of the requested compute)."""
+    if scaling.num_workers <= 1:
+        return 1, 0
+    try:
+        import jax
+        count, index = jax.process_count(), jax.process_index()
+    except Exception:  # noqa: BLE001 - jax unavailable
+        count, index = 1, 0
+    if count < scaling.num_workers:
+        raise ValueError(
+            f"ScalingConfig(num_workers={scaling.num_workers}) but the jax "
+            f"process world has {count} process(es). Initialize multi-host "
+            f"first (ray_tpu.parallel.distributed.init / jax.distributed) or "
+            f"set num_workers=1; refusing to silently train on "
+            f"1/{scaling.num_workers} of the requested compute.")
+    return count, index
+
+
+class TrainWorker:
+    """The worker actor hosting the train loop (reference: worker_group's
+    RayTrainWorker). Restart semantics: `max_restarts` respawns the process,
+    `max_task_retries` re-runs `run()`, and run_training resumes from the
+    newest checkpoint on disk."""
+
+    def __init__(self, loop_blob: bytes, train_loop_config: Dict,
+                 scaling: ScalingConfig, run_cfg: RunConfig,
+                 datasets: Dict[str, Any], resume_ckpt_path: Optional[str],
+                 run_id: Optional[str] = None):
+        import cloudpickle
+        self._loop = cloudpickle.loads(loop_blob)
+        self._cfg = train_loop_config
+        self._scaling = scaling
+        self._run_cfg = run_cfg
+        self._datasets = datasets
+        self._resume = resume_ckpt_path
+        self._run_id = run_id
+
+    def run(self) -> Dict[str, Any]:
+        return run_training(self._loop, self._cfg, self._scaling,
+                            self._run_cfg, self._datasets, self._resume,
+                            run_id=self._run_id)
+
+    def ping(self):
+        return "pong"

@@ -8,6 +8,30 @@ gracefully off-TPU so CPU tests can exercise the logic via env injection.
 import os
 from typing import Dict, List, Optional, Tuple
 
+# Env vars that bind a process to the accelerator runtime. The single source
+# of truth for every "scrub the TPU env for a CPU-only child" site
+# (controller CPU workers, bench.py, __graft_entry__.dryrun_multichip) —
+# round-1 postmortem: divergent copies of this list caused TPU-plugin init
+# hangs in whichever path missed a key.
+ACCEL_ENV_KEYS = ("PALLAS_AXON_POOL_IPS", "TPU_WORKER_HOSTNAMES",
+                  "PALLAS_AXON_TPU_GEN", "PALLAS_AXON_REMOTE_COMPILE")
+
+
+def scrub_accel_env(env: dict, n_cpu_devices: Optional[int] = None) -> dict:
+    """Return a copy of `env` bound to CPU-only jax: accelerator vars
+    removed, JAX_PLATFORMS=cpu, optionally a virtual CPU device count."""
+    env = dict(env)
+    for k in ACCEL_ENV_KEYS:
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    if n_cpu_devices is not None:
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if "host_platform_device_count" not in f]
+        flags.append(f"--xla_force_host_platform_device_count={n_cpu_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
 # generation → (chips per host, cores per chip)
 _GEN_INFO = {
     "v2": (4, 2), "v3": (4, 2), "v4": (4, 2),
@@ -106,3 +130,21 @@ def mesh_shape_for_slice(tp: int = 1) -> Tuple[int, int]:
     if chips % tp:
         raise ValueError(f"tp={tp} does not divide {chips} chips")
     return chips // tp, tp
+
+
+# generation → peak bf16 dense FLOP/s per chip (published spec sheets; used
+# for MFU accounting, not scheduling decisions).
+_PEAK_BF16_FLOPS = {
+    "v2": 46e12, "v3": 123e12, "v4": 275e12,
+    "v5e": 197e12, "v5litepod": 197e12, "v5p": 459e12, "v6e": 918e12,
+}
+
+
+def peak_flops_per_chip(gen: Optional[str] = None) -> Optional[float]:
+    """Peak bf16 FLOP/s for one chip of `gen` (default: detected generation).
+    Returns None when the generation is unknown — callers must treat MFU as
+    unmeasurable rather than dividing by a guess."""
+    gen = gen or get_tpu_generation()
+    if gen is None:
+        return None
+    return _PEAK_BF16_FLOPS.get(gen.lower())

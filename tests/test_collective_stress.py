@@ -1,0 +1,54 @@
+"""Regression: host-collective rendezvous under concurrent first-dispatch.
+
+Round-1 bug: an async actor's first two method calls arriving on two pool
+threads at once raced WorkerState.get_async_loop into creating TWO event
+loops; coroutines split across loops and asyncio.Event.set() on one loop
+never woke waiters on the other → allreduce hung (GetTimeoutError after 60s).
+Two collective ranks hitting a fresh rendezvous actor is exactly that
+pattern, so this hammers it: many fresh groups, ranks submitted
+back-to-back, with background task/actor churn to load the worker pool.
+"""
+
+import numpy as np
+import pytest
+
+
+@pytest.mark.parametrize("world", [2, 3])
+def test_host_collective_concurrent_groups(ray_session, world):
+    ray = ray_session
+
+    @ray.remote
+    def churn(x):
+        return x + 1
+
+    @ray.remote
+    class Member:
+        def _init_collective(self, world_size, rank, backend, group_name):
+            from ray_tpu.parallel import collective as col
+            col.destroy_collective_group(group_name)
+            col.init_collective_group(world_size, rank, backend, group_name)
+            return True
+
+        def do_allreduce(self, x, group):
+            from ray_tpu.parallel import collective as col
+            return col.allreduce(np.asarray(x, np.float32), group_name=group)
+
+    from ray_tpu.parallel.collective import create_collective_group
+
+    for it in range(6):
+        group = f"stress_w{world}_{it}"
+        churn_refs = [churn.remote(i) for i in range(4)]
+        members = [Member.remote() for _ in range(world)]
+        create_collective_group(members, world, list(range(world)),
+                                backend="host", group_name=group)
+        # submit all ranks back-to-back so the rendezvous actor sees them
+        # nearly simultaneously (the race window)
+        refs = [m.do_allreduce.remote([float(r), 1.0], group)
+                for r, m in enumerate(members)]
+        outs = ray.get(refs, timeout=60)
+        expected = [sum(range(world)), float(world)]
+        for o in outs:
+            np.testing.assert_allclose(o, expected)
+        assert ray.get(churn_refs, timeout=30) == [1, 2, 3, 4]
+        for m in members:
+            ray.kill(m)

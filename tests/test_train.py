@@ -160,6 +160,8 @@ def test_session_context_and_datasets(tmp_path):
         loop,
         datasets={"train": [1, 2, 3]},
         run_config=RunConfig(name="sess", storage_path=str(tmp_path)),
+        # the loop mutates a driver closure — needs in-process execution
+        use_worker_actor=False,
     ).fit()
     assert seen["world"] == (1, 0)
     assert seen["data"] == [1, 2, 3]

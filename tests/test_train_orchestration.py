@@ -1,0 +1,87 @@
+"""Train worker orchestration (VERDICT r1 #2): the loop runs in a
+restartable actor; a killed worker process resumes from the last on-disk
+checkpoint via the actor restart path (not an in-process try/except), and
+num_workers>1 without a jax.distributed world fails loudly."""
+
+import os
+
+import numpy as np
+import pytest
+
+from ray_tpu.train import (Checkpoint, FailureConfig, JaxTrainer, RunConfig,
+                           ScalingConfig)
+
+
+def _make_crashy_loop():
+    """Counts iterations via checkpoints; hard-kills its own process once at
+    iteration `die_at` (SIGKILL semantics — no Python except path can catch
+    it, so recovery MUST come from actor restart + on-disk checkpoint).
+    Built inside a function so cloudpickle serializes it by value — workers
+    can't import pytest's top-level test module."""
+
+    def _crashy_loop(config):
+        import os
+        from ray_tpu import train
+        from ray_tpu.train import Checkpoint
+
+        start = 1
+        ckpt = train.get_checkpoint()
+        if ckpt is not None:
+            start = ckpt.to_state()["it"] + 1
+        flag = config["flag"]
+        for it in range(start, config["steps"] + 1):
+            if it == config["die_at"] and not os.path.exists(flag):
+                with open(flag, "w") as f:
+                    f.write("died")
+                os._exit(1)  # simulates OOM-kill / segfault of the worker
+            train.report({"it": it},
+                         checkpoint=Checkpoint.from_state({"it": it}))
+
+    return _crashy_loop
+
+
+def test_actor_kill_mid_run_resumes_from_checkpoint(ray_session, tmp_path):
+    _crashy_loop = _make_crashy_loop()
+    flag = str(tmp_path / "died_once")
+    trainer = JaxTrainer(
+        _crashy_loop,
+        train_loop_config={"steps": 6, "die_at": 4, "flag": flag},
+        run_config=RunConfig(
+            name="crashrec", storage_path=str(tmp_path),
+            failure_config=FailureConfig(max_failures=2)),
+        use_worker_actor=True,
+    )
+    result = trainer.fit()
+    assert os.path.exists(flag), "loop never reached the crash point"
+    assert result.error is None
+    # iterations 1..3 before the crash, resumed at 4 (from ckpt it=3), ran to 6
+    its = [m["it"] for m in result.metrics_history]
+    assert its == [1, 2, 3, 4, 5, 6], its
+    assert result.checkpoint.to_state()["it"] == 6
+
+
+def test_actor_path_plain_fit(ray_session, tmp_path):
+    def loop(config):
+        from ray_tpu import train
+        for i in range(3):
+            train.report({"loss": 1.0 / (i + 1)})
+
+    result = JaxTrainer(
+        loop,
+        run_config=RunConfig(name="plain", storage_path=str(tmp_path)),
+        use_worker_actor=True,
+    ).fit()
+    assert result.error is None
+    assert len(result.metrics_history) == 3
+    assert result.metrics["loss"] == pytest.approx(1 / 3)
+
+
+def test_num_workers_without_world_fails_loudly(tmp_path):
+    trainer = JaxTrainer(
+        lambda config: None,  # in-process path: no pickling involved
+        scaling_config=ScalingConfig(num_workers=4),
+        run_config=RunConfig(name="nw", storage_path=str(tmp_path)),
+        use_worker_actor=False,
+    )
+    with pytest.raises(ValueError, match="num_workers=4"):
+        trainer.fit()
