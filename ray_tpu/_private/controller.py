@@ -50,6 +50,7 @@ class TaskRecord:
     result_oids: List[str]
     state: str = PENDING
     retries_left: int = 0
+    reconstructions_left: int = -1  # lazily set on first lineage recovery
     worker_id: Optional[str] = None
     done: asyncio.Event = field(default_factory=asyncio.Event)
     deps_remaining: Set[str] = field(default_factory=set)
@@ -131,6 +132,7 @@ class Controller:
 
         self.objects: Dict[str, ObjectMeta] = {}
         self.object_events: Dict[str, asyncio.Event] = {}
+        self.lineage: Dict[str, str] = {}  # evicted oid -> creating task id
         self.tasks: Dict[str, TaskRecord] = {}
         self.ready_queue: collections.deque = collections.deque()
         self.dep_waiters: Dict[str, Set[str]] = collections.defaultdict(set)
@@ -736,12 +738,16 @@ class Controller:
 
     async def get_descriptors(self, oids: List[str], timeout: Optional[float]):
         """Wait for availability; return per-object descriptors the caller can
-        materialize locally: ("shm", meta_len) | ("inline", bytes) | ("err", e)."""
+        materialize locally: ("shm", meta_len) | ("inline", bytes) | ("err", e).
+        Lost objects (evicted registry entry, vanished shm segment, missing
+        spill file) are transparently reconstructed from lineage."""
         deadline = None if timeout is None else time.monotonic() + timeout
         for oid in oids:
             ev = self.object_events.get(oid)
             if ev is None:
-                raise exc.ObjectLostError(oid)
+                if not await self._recover_object(oid):
+                    raise exc.ObjectLostError(oid)
+                ev = self.object_events[oid]
             remaining = None if deadline is None else deadline - time.monotonic()
             if remaining is not None and remaining <= 0 and not ev.is_set():
                 raise exc.GetTimeoutError(f"get() timed out waiting for {oid}")
@@ -751,20 +757,38 @@ class Controller:
                 raise exc.GetTimeoutError(f"get() timed out waiting for {oid}") from None
         out = []
         for oid in oids:
-            meta = self.objects[oid]
-            if meta.location == "error":
-                out.append(("err", meta.error))
-            elif meta.location == "inline":
-                out.append(("inline", meta.inline_value))
-            else:
-                self._ensure_local(oid)
-                out.append(("shm", meta.meta_len))
+            out.append(await self._descriptor(oid, deadline))
         return out
+
+    async def _descriptor(self, oid: str, deadline, _depth: int = 0):
+        meta = self.objects[oid]
+        if meta.location == "error":
+            return ("err", meta.error)
+        if meta.location == "inline":
+            return ("inline", meta.inline_value)
+        lost = False
+        try:
+            self._ensure_local(oid)  # restores spilled data
+            lost = meta.location == "shm" and not self.store.exists(oid)
+        except (FileNotFoundError, OSError):
+            lost = True  # spill file vanished
+        if not lost:
+            return ("shm", meta.meta_len)
+        if _depth >= 3 or not await self._recover_object(oid):
+            return ("err", exc.ObjectLostError(oid))
+        remaining = None if deadline is None else deadline - time.monotonic()
+        try:
+            await asyncio.wait_for(self.object_events[oid].wait(), remaining)
+        except asyncio.TimeoutError:
+            raise exc.GetTimeoutError(
+                f"get() timed out reconstructing {oid}") from None
+        return await self._descriptor(oid, deadline, _depth + 1)
 
     async def wait(self, oids, num_returns, timeout):
         for oid in oids:
             if oid not in self.object_events:
-                raise exc.ObjectLostError(oid)
+                if not await self._recover_object(oid):
+                    raise exc.ObjectLostError(oid)
         deadline = None if timeout is None else time.monotonic() + timeout
         events = {oid: self.object_events[oid] for oid in oids}
         waiters = {oid: asyncio.ensure_future(ev.wait())
@@ -819,9 +843,93 @@ class Controller:
             except OSError:
                 pass
         self.object_events.pop(oid, None)
+        if meta.creating_task:
+            # lineage survives the data: a borrowed ref deserialized later can
+            # still trigger reconstruction (ref: object_recovery_manager.cc)
+            self.lineage[oid] = meta.creating_task
         if meta.contained:
             # the container's bytes are gone; drop its holds on nested objects
             self.decref(meta.contained)
+
+    # ------------------------------------------------------ lineage recovery
+    def _lineage_rec(self, oid: str) -> Optional[TaskRecord]:
+        """The creating task's record, if this object is reconstructable
+        (plain task output; actor methods would re-run against mutated state
+        and streams have per-item ids — both non-deterministic, refused,
+        matching the reference's plain-task-only recovery)."""
+        meta = self.objects.get(oid)
+        tid = meta.creating_task if meta is not None else self.lineage.get(oid)
+        rec = self.tasks.get(tid) if tid else None
+        if rec is None:
+            return None
+        spec = rec.spec
+        if spec.actor_id or spec.num_returns == "streaming":
+            return None
+        return rec
+
+    async def _recover_object(self, oid: str) -> bool:
+        """Re-execute the creating task so `oid` materializes again
+        (reference: src/ray/core_worker/object_recovery_manager.cc:1-191).
+        Returns True when a reconstruction is running (or already queued)."""
+        rec = self._lineage_rec(oid)
+        if rec is None:
+            return False
+        if rec.state in (PENDING, PENDING_DEPS, "SPAWNING", RUNNING):
+            return True  # reconstruction already in flight
+        if rec.reconstructions_left < 0:
+            # budget: at least one recovery even for max_retries=0 tasks —
+            # losing a result to eviction is not the task's failure
+            rec.reconstructions_left = max(rec.spec.max_retries, 1)
+        if rec.reconstructions_left == 0:
+            return False
+        rec.reconstructions_left -= 1
+        spec = rec.spec
+        # resurrect result object slots
+        for roid in rec.result_oids:
+            meta = self.objects.get(roid)
+            if meta is None:
+                self.objects[roid] = ObjectMeta(object_id=roid,
+                                                creating_task=spec.task_id,
+                                                refcount=1)
+            else:
+                meta.location = "pending"
+                meta.inline_value = None
+                meta.spill_path = None
+            ev = self.object_events.get(roid)
+            if ev is None or ev.is_set():
+                self.object_events[roid] = asyncio.Event()
+            self.lineage.pop(roid, None)
+        fresh = TaskRecord(spec=spec, result_oids=rec.result_oids,
+                           retries_left=spec.max_retries,
+                           ts_submit=time.time())
+        fresh.reconstructions_left = rec.reconstructions_left
+        self.tasks[spec.task_id] = fresh
+        # recover lost ref args first (recursive lineage walk), then wire
+        # deps exactly like submit()
+        for kind, v in list(spec.args) + list(spec.kwargs.values()):
+            if kind != "ref":
+                continue
+            arg_meta = self.objects.get(v)
+            arg_lost = (arg_meta is None or
+                        (arg_meta.location == "shm"
+                         and not self.store.exists(v)))
+            if arg_lost and not await self._recover_object(v):
+                err = exc.ObjectLostError(v)
+                self._fail_task(fresh, err)
+                return False
+            arg_meta = self.objects.get(v)
+            if arg_meta is not None:
+                arg_meta.pinned += 1
+                fresh.pinned.append(v)
+            if arg_meta is None or arg_meta.location == "pending":
+                fresh.deps_remaining.add(v)
+                self.dep_waiters[v].add(spec.task_id)
+        if fresh.deps_remaining:
+            fresh.state = PENDING_DEPS
+        else:
+            self._enqueue_ready(fresh)
+        self._schedule()
+        return True
 
     # ---------------------------------------------------------------- streaming
     def _on_stream_item(self, p: dict):

@@ -186,6 +186,19 @@ class StoreClient:
                 # numpy views still alive; re-pin until they die.
                 self._attached[object_id] = loc
 
+    def exists(self, object_id: str) -> bool:
+        """Is the object's backing storage still present? (lineage recovery
+        uses this to detect data loss behind a live registry entry)."""
+        if self._slab is not None:
+            return self._slab.lookup(object_id) is not None
+        try:
+            shm = shared_memory.SharedMemory(name=seg_name(object_id))
+            _unregister(shm)
+            shm.close()
+            return True
+        except FileNotFoundError:
+            return False
+
     def delete_segment(self, object_id: str):
         """Free the object's storage (controller-side eviction)."""
         self.release(object_id)

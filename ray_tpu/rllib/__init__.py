@@ -11,7 +11,7 @@ from .algorithms import (APPO, APPOConfig, BC, BCConfig, DQN, DQNConfig,
                          PPOConfig, SAC, SACConfig)
 from .buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .env_runner import EnvRunner
-from .learner import JaxLearner, LearnerGroup
+from .learner import JaxLearner, LearnerGroup, make_learner_group
 from .rl_module import ModuleSpec, RLModule
 from .sample_batch import SampleBatch
 

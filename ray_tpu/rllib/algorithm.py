@@ -42,6 +42,9 @@ class AlgorithmConfig:
         # learners
         self.num_learners = 0
         self.num_tpus_per_learner = 0
+        # multi-agent (None = single-agent)
+        self.policies = None
+        self.policy_mapping_fn = None
         # evaluation
         self.evaluation_interval = 0
         self.evaluation_duration = 5
@@ -95,6 +98,18 @@ class AlgorithmConfig:
             self.num_learners = num_learners
         if num_tpus_per_learner is not None:
             self.num_tpus_per_learner = num_tpus_per_learner
+        return self
+
+    def multi_agent(self, *, policies=None, policy_mapping_fn=None, **_):
+        """Reference: AlgorithmConfig.multi_agent(policies={...},
+        policy_mapping_fn=fn). `policies` is a set/list of policy ids;
+        policy_mapping_fn(agent_id) -> policy_id. All agents mapping to one
+        policy = shared/parameter-sharing mode; one policy per agent =
+        independent learners."""
+        if policies is not None:
+            self.policies = sorted(policies)
+        if policy_mapping_fn is not None:
+            self.policy_mapping_fn = policy_mapping_fn
         return self
 
     def evaluation(self, *, evaluation_interval=None, evaluation_duration=None, **_):

@@ -13,7 +13,7 @@ import numpy as np
 
 from .. import sample_batch as SB
 from ..algorithm import Algorithm, AlgorithmConfig
-from ..learner import JaxLearner, _host_metrics
+from ..learner import JaxLearner, _host_metrics, make_learner_group
 from ..rl_module import ModuleSpec, RLModule
 from ..sample_batch import SampleBatch
 
@@ -75,7 +75,9 @@ class BC(Algorithm):
             spec = ModuleSpec(obs_shape, "continuous",
                               int(np.prod(np.asarray(acts).shape[1:])),
                               tuple(config.model.get("hiddens", (256, 256))))
-        self.learner = BCLearner(RLModule(spec), config, seed=config.seed)
+        self.learner_group = make_learner_group(BCLearner, RLModule(spec),
+                                                config, seed=config.seed)
+        self.learner = self.learner_group.learner
         self._rng = np.random.default_rng(config.seed)
         self._n = n
 

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 from ray_tpu.ops.losses import vtrace
 from .. import sample_batch as SB
 from ..algorithm import Algorithm, AlgorithmConfig, _merge_runner_metrics
-from ..learner import JaxLearner, _host_metrics
+from ..learner import JaxLearner, _host_metrics, make_learner_group
 from ..rl_module import RLModule
 from ..sample_batch import SampleBatch
 
@@ -71,7 +71,9 @@ class IMPALA(Algorithm):
     def setup(self, config: IMPALAConfig):
         self._setup_runners()
         spec = self._local_runner.get_spec()
-        self.learner = IMPALALearner(RLModule(spec), config, seed=config.seed)
+        self.learner_group = make_learner_group(IMPALALearner, RLModule(spec),
+                                                config, seed=config.seed)
+        self.learner = self.learner_group.learner
 
     def training_step(self) -> Dict:
         cfg = self.config

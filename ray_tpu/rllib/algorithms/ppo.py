@@ -15,7 +15,7 @@ from ray_tpu.ops.losses import clipped_value_loss, ppo_surrogate
 from .. import sample_batch as SB
 from ..algorithm import Algorithm, AlgorithmConfig
 from ..connectors import compute_gae, standardize_advantages
-from ..learner import JaxLearner, LearnerGroup, _host_metrics
+from ..learner import JaxLearner, LearnerGroup, _host_metrics, make_learner_group
 from ..rl_module import RLModule
 from ..sample_batch import SampleBatch
 
@@ -81,12 +81,73 @@ class PPOLearner(JaxLearner):
 
 class PPO(Algorithm):
     def setup(self, config: PPOConfig):
+        if config.policies:
+            return self._setup_multi_agent(config)
         self._setup_runners()
         spec = self._local_runner.get_spec()
-        self.learner = PPOLearner(RLModule(spec), config, seed=config.seed)
-        self.learner_group = LearnerGroup(self.learner)
+        self.learner_group = make_learner_group(PPOLearner, RLModule(spec),
+                                                config, seed=config.seed)
+        self.learner = self.learner_group.learner
+
+    # -- multi-agent mode (reference: rllib/env/multi_agent_env.py + policy
+    # map; one PPOLearner per policy, agents batched per policy) -----------
+    def _setup_multi_agent(self, config):
+        from ..multi_agent import MultiAgentEnvRunner, module_specs_for
+        mapping = config.policy_mapping_fn or (lambda aid: config.policies[0])
+        probe_env = config.env() if callable(config.env) else config.env
+        specs = module_specs_for(
+            probe_env, mapping,
+            hiddens=tuple(config.model.get("hiddens", (256, 256))))
+        missing = set(specs) - set(config.policies)
+        if missing:
+            raise ValueError(f"policy_mapping_fn produced unknown policies "
+                             f"{sorted(missing)}; declared {config.policies}")
+        self.ma_learner_groups = {
+            pid: make_learner_group(PPOLearner, RLModule(specs[pid]), config,
+                                    seed=config.seed + i)
+            for i, pid in enumerate(sorted(specs))}
+        self._ma_runner = MultiAgentEnvRunner(
+            (config.env if callable(config.env)
+             else (lambda: probe_env)),
+            policy_mapping_fn=mapping,
+            modules={pid: g.learner.module
+                     for pid, g in self.ma_learner_groups.items()},
+            rollout_len=config.rollout_fragment_length,
+            explore=config.explore, seed=config.seed)
+        self._iteration_ma = 0
+
+    def _training_step_multi_agent(self) -> Dict:
+        cfg = self.config
+        weights = {pid: g.get_weights()
+                   for pid, g in self.ma_learner_groups.items()}
+        timesteps = 0
+        runner_metrics = []
+        learn: Dict[str, Dict] = {}
+        per_policy: Dict[str, list] = {pid: [] for pid in self.ma_learner_groups}
+        while timesteps < cfg.train_batch_size:
+            ma_batch, rm = self._ma_runner.sample(weights)
+            runner_metrics.append(rm)
+            timesteps += ma_batch.env_steps()
+            for pid, batch in ma_batch.policy_batches.items():
+                per_policy[pid].append(batch)
+        for pid, batches in per_policy.items():
+            if not batches:
+                continue
+            batch = (batches[0] if len(batches) == 1 else
+                     SampleBatch.concat(batches, axis=1))
+            batch = compute_gae(batch, cfg.gamma, cfg.lambda_)
+            if cfg.standardize_advantages:
+                batch = standardize_advantages(batch)
+            learn[pid] = self.ma_learner_groups[pid].update(batch)
+        from ..algorithm import _merge_runner_metrics
+        result = _merge_runner_metrics(runner_metrics)
+        result["num_env_steps_sampled_this_iter"] = timesteps
+        result["learner"] = learn  # keyed per policy (reference layout)
+        return result
 
     def training_step(self) -> Dict:
+        if self.config.policies:
+            return self._training_step_multi_agent()
         cfg = self.config
         weights = self.learner.get_weights()
         collected = []
@@ -109,8 +170,38 @@ class PPO(Algorithm):
         result["learner"] = learn
         return result
 
+    def evaluate(self) -> Dict:
+        if not self.config.policies:
+            return super().evaluate()
+        from ..multi_agent import MultiAgentEnvRunner
+        cfg = self.config
+        runner = MultiAgentEnvRunner(
+            cfg.env if callable(cfg.env) else (lambda: cfg.env),
+            policy_mapping_fn=cfg.policy_mapping_fn,
+            modules={pid: g.learner.module
+                     for pid, g in self.ma_learner_groups.items()},
+            rollout_len=cfg.rollout_fragment_length,
+            explore=False, seed=cfg.seed + 10_000)
+        weights = {pid: g.get_weights()
+                   for pid, g in self.ma_learner_groups.items()}
+        episodes = 0
+        merged: Dict = {}
+        while episodes < cfg.evaluation_duration:
+            _b, m = runner.sample(weights)
+            episodes += m.get("episodes_this_iter", 0)
+            if "episode_return_mean" in m:
+                merged = m
+        return merged
+
     def get_weights(self):
+        if self.config.policies:
+            return {pid: g.get_weights()
+                    for pid, g in self.ma_learner_groups.items()}
         return self.learner.get_weights()
 
     def set_weights(self, weights):
+        if self.config.policies:
+            for pid, w in weights.items():
+                self.ma_learner_groups[pid].set_weights(w)
+            return
         self.learner.set_weights(weights)

@@ -1,0 +1,244 @@
+"""Multi-agent RL (reference: rllib/env/multi_agent_env.py:1-807 +
+rllib/policy/policy_map.py + multi-agent episode handling).
+
+Protocol: a MultiAgentEnv steps ALL live agents simultaneously with dict
+observations/actions keyed by agent id (the reference's simultaneous-action
+subset — turn-based envs can no-op absent agents). A policy_mapping_fn
+assigns each agent to a policy id; "shared" vs "independent" learning are
+just different mappings (all→one policy / one policy per agent).
+
+TPU-native collection: per policy, the runner stacks that policy's agents
+into one [T, k] rollout and runs ONE jitted explore_step per env step per
+policy (agents of a policy are batch rows — no per-agent Python forward).
+Training updates each policy's learner with its own [T, k] batch; under a
+multi-learner group those updates ride the dp mesh like single-agent PPO.
+"""
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import sample_batch as SB
+from .rl_module import ModuleSpec, RLModule
+from .sample_batch import SampleBatch
+
+
+class MultiAgentEnv:
+    """Base class (reference: ray.rllib.env.MultiAgentEnv).
+
+    Subclasses define:
+      possible_agents: list of agent ids
+      observation_spaces / action_spaces: {agent_id: gymnasium.Space}
+      reset(seed=None) -> (obs_dict, info_dict)
+      step(action_dict) -> (obs, rewards, terminateds, truncateds, infos),
+        each a per-agent dict; terminateds/truncateds carry "__all__".
+    """
+
+    possible_agents: List[str] = []
+    observation_spaces: Dict[str, Any] = {}
+    action_spaces: Dict[str, Any] = {}
+
+    def reset(self, *, seed: Optional[int] = None
+              ) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict[str, Any]):
+        raise NotImplementedError
+
+    def get_observation_space(self, agent_id: str):
+        return self.observation_spaces[agent_id]
+
+    def get_action_space(self, agent_id: str):
+        return self.action_spaces[agent_id]
+
+
+class MultiAgentBatch:
+    """{policy_id: SampleBatch([T, k])} + env step count (reference:
+    rllib/policy/sample_batch.py MultiAgentBatch)."""
+
+    def __init__(self, policy_batches: Dict[str, SampleBatch],
+                 env_steps: int):
+        self.policy_batches = policy_batches
+        self._env_steps = env_steps
+
+    def env_steps(self) -> int:
+        return self._env_steps
+
+    def agent_steps(self) -> int:
+        return sum(b[SB.REWARDS].size for b in self.policy_batches.values())
+
+    def __getitem__(self, policy_id: str) -> SampleBatch:
+        return self.policy_batches[policy_id]
+
+    def keys(self):
+        return self.policy_batches.keys()
+
+
+class MultiAgentEnvRunner:
+    """Collects [T, k]-shaped per-policy rollouts from one MultiAgentEnv.
+
+    All of a policy's agents are rows of one batched forward — the jitted
+    explore_step runs once per policy per env step regardless of how many
+    agents share it.
+    """
+
+    def __init__(self, env_creator: Callable[[], MultiAgentEnv], *,
+                 policy_mapping_fn: Callable[[str], str],
+                 modules: Dict[str, RLModule],
+                 rollout_len: int = 200, explore: bool = True, seed: int = 0):
+        self.env = env_creator()
+        self.policy_mapping_fn = policy_mapping_fn
+        self.modules = modules
+        self.rollout_len = rollout_len
+        self.explore = explore
+        self._seed = seed
+        self._step_count = 0
+        self.agents = list(self.env.possible_agents)
+        # stable agent order per policy → fixed batch rows, no recompiles
+        self.policy_agents: Dict[str, List[str]] = {}
+        for aid in self.agents:
+            pid = policy_mapping_fn(aid)
+            if pid not in modules:
+                raise KeyError(f"policy_mapping_fn({aid!r}) -> {pid!r} not in "
+                               f"policies {sorted(modules)}")
+            self.policy_agents.setdefault(pid, []).append(aid)
+        self._jit = {}
+        self._obs: Optional[Dict[str, Any]] = None
+        self._ep_return = 0.0
+        self._ep_len = 0
+        self._completed: List[Dict] = []
+
+    def init_params(self) -> Dict[str, Any]:
+        import jax
+        with jax.default_device(jax.local_devices(backend="cpu")[0]):
+            return {pid: jax.device_get(m.init(jax.random.PRNGKey(
+                self._seed + i)))
+                    for i, (pid, m) in enumerate(sorted(self.modules.items()))}
+
+    def _ensure_jit(self):
+        import jax
+        if self._jit:
+            return
+        self._cpu = jax.local_devices(backend="cpu")[0]
+        for pid, module in self.modules.items():
+            def explore(params, obs, key, _m=module):
+                return _m.explore_step(params, obs, key)
+
+            def infer(params, obs, _m=module):
+                a, v = _m.inference_step(params, obs)
+                return a, np.zeros(1, np.float32), v
+
+            def values(params, obs, _m=module):
+                _, v = _m.forward(params, obs)
+                return v
+
+            self._jit[pid] = (
+                jax.jit(explore if self.explore else
+                        (lambda p, o, k, _i=infer: _i(p, o))),
+                jax.jit(values))
+
+    def _stack_obs(self, obs: Dict[str, Any], pid: str) -> np.ndarray:
+        return np.stack([np.asarray(obs[a], np.float32)
+                         for a in self.policy_agents[pid]])
+
+    def sample(self, params_per_policy: Dict[str, Any]
+               ) -> Tuple[MultiAgentBatch, Dict]:
+        import jax
+        self._ensure_jit()
+        T = self.rollout_len
+        if self._obs is None:
+            self._obs, _ = self.env.reset(seed=self._seed)
+
+        bufs = {}
+        for pid, agents in self.policy_agents.items():
+            k = len(agents)
+            obs_shape = np.asarray(self._obs[agents[0]]).shape
+            bufs[pid] = {
+                SB.OBS: np.empty((T, k) + obs_shape, np.float32),
+                SB.ACTIONS: None,
+                SB.REWARDS: np.zeros((T, k), np.float32),
+                SB.DONES: np.zeros((T, k), np.float32),
+                "terms": np.zeros((T, k), np.float32),
+                SB.LOGP: np.zeros((T, k), np.float32),
+                SB.VF_PREDS: np.zeros((T, k), np.float32),
+            }
+
+        key = jax.random.PRNGKey(self._seed ^ 0x5eed)
+        with jax.default_device(self._cpu):
+            for t in range(T):
+                self._step_count += 1
+                k = jax.random.fold_in(key, self._step_count)
+                action_dict = {}
+                for pid, agents in self.policy_agents.items():
+                    ob = self._stack_obs(self._obs, pid)
+                    a, logp, v = self._jit[pid][0](params_per_policy[pid],
+                                                   ob, k)
+                    a = np.asarray(a)
+                    b = bufs[pid]
+                    if b[SB.ACTIONS] is None:
+                        b[SB.ACTIONS] = np.empty((T,) + a.shape, a.dtype)
+                    b[SB.OBS][t] = ob
+                    b[SB.ACTIONS][t] = a
+                    b[SB.LOGP][t] = np.asarray(logp)
+                    b[SB.VF_PREDS][t] = np.asarray(v)
+                    for i, aid in enumerate(agents):
+                        action_dict[aid] = a[i]
+                obs, rew, term, trunc, _info = self.env.step(action_dict)
+                done_all = bool(term.get("__all__", False)
+                                or trunc.get("__all__", False))
+                for pid, agents in self.policy_agents.items():
+                    b = bufs[pid]
+                    for i, aid in enumerate(agents):
+                        b[SB.REWARDS][t, i] = rew.get(aid, 0.0)
+                        agent_term = bool(term.get(aid, False))
+                        b["terms"][t, i] = float(agent_term)
+                        b[SB.DONES][t, i] = float(agent_term or done_all or
+                                                  bool(trunc.get(aid, False)))
+                self._ep_return += float(sum(rew.values()))
+                self._ep_len += 1
+                if done_all:
+                    self._completed.append({"return": self._ep_return,
+                                            "len": self._ep_len})
+                    self._ep_return, self._ep_len = 0.0, 0
+                    obs, _ = self.env.reset()
+                self._obs = obs
+
+            batches = {}
+            for pid, agents in self.policy_agents.items():
+                b = bufs[pid]
+                boot = np.asarray(self._jit[pid][1](
+                    params_per_policy[pid], self._stack_obs(self._obs, pid)))
+                boot = boot * (1.0 - b["terms"][-1])
+                terms = b.pop("terms")
+                del terms
+                b[SB.BOOTSTRAP_VALUE] = boot
+                batches[pid] = SampleBatch(b)
+
+        metrics = self._metrics()
+        return MultiAgentBatch(batches, env_steps=T), metrics
+
+    def _metrics(self) -> Dict:
+        eps = self._completed
+        self._completed = []
+        if not eps:
+            return {"episodes_this_iter": 0}
+        rets = [e["return"] for e in eps]
+        lens = [e["len"] for e in eps]
+        return {"episodes_this_iter": len(eps),
+                "episode_return_mean": float(np.mean(rets)),
+                "episode_return_max": float(np.max(rets)),
+                "episode_return_min": float(np.min(rets)),
+                "episode_len_mean": float(np.mean(lens))}
+
+
+def module_specs_for(env: MultiAgentEnv, policy_mapping_fn: Callable,
+                     hiddens=(256, 256)) -> Dict[str, ModuleSpec]:
+    """One ModuleSpec per policy from a representative agent's spaces."""
+    specs = {}
+    for aid in env.possible_agents:
+        pid = policy_mapping_fn(aid)
+        if pid not in specs:
+            specs[pid] = ModuleSpec.from_spaces(
+                env.get_observation_space(aid), env.get_action_space(aid),
+                hiddens=hiddens)
+    return specs

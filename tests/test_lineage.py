@@ -1,0 +1,90 @@
+"""Lineage reconstruction (VERDICT r1 #7; reference:
+src/ray/core_worker/object_recovery_manager.cc:1-191): a lost object whose
+creating task is known is re-executed transparently at get() time."""
+
+import numpy as np
+import pytest
+
+
+def _controller():
+    from ray_tpu._private import state
+    return state.global_client().controller
+
+
+def _zap(ref):
+    """Destroy the object's backing storage, leaving the registry entry —
+    simulates segment loss / eviction under memory pressure."""
+    ctrl = _controller()
+    ctrl.store.delete_segment(ref.id)
+
+
+def test_get_reconstructs_lost_task_output(ray_session):
+    ray = ray_session
+
+    calls = {"n": 0}
+
+    @ray.remote
+    def make_array(seed):
+        # >64KB so the result lands in shm, not inline
+        rng = np.random.default_rng(seed)
+        return rng.normal(size=(64, 256)).astype(np.float64)
+
+    ref = make_array.remote(7)
+    first = np.array(ray.get(ref), copy=True)
+    _zap(ref)
+    second = ray.get(ref)  # must re-execute make_array, not raise
+    np.testing.assert_allclose(first, second)
+
+
+def test_chained_lineage_recovers_upstream_first(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def base():
+        return np.arange(20_000, dtype=np.float64)
+
+    @ray.remote
+    def double(x):
+        return x * 2
+
+    b = base.remote()
+    d = double.remote(b)
+    expected = np.array(ray.get(d), copy=True)
+    _zap(b)
+    _zap(d)
+    out = ray.get(d)  # recovers base, then double
+    np.testing.assert_allclose(out, expected)
+    np.testing.assert_allclose(np.array(ray.get(b)), np.arange(20_000) * 1.0)
+
+
+def test_put_objects_are_not_reconstructable(ray_session):
+    ray = ray_session
+    from ray_tpu.exceptions import ObjectLostError
+
+    ref = ray.put(np.ones(20_000))  # no creating task -> no lineage
+    _zap(ref)
+    with pytest.raises(ObjectLostError):
+        ray.get(ref, timeout=30)
+
+
+def test_actor_outputs_are_not_reconstructed(ray_session):
+    ray = ray_session
+    from ray_tpu.exceptions import ObjectLostError
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return np.full(20_000, self.n)  # big enough for shm
+
+    c = Counter.remote()
+    ref = c.bump.remote()
+    assert ray.get(ref)[0] == 1
+    _zap(ref)
+    # re-running bump() would return 2, not 1 — refuse instead of lying
+    with pytest.raises(ObjectLostError):
+        ray.get(ref, timeout=30)
+    ray.kill(c)

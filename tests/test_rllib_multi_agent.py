@@ -1,0 +1,133 @@
+"""RLlib multi-agent basics (VERDICT r1 #6; reference:
+rllib/env/multi_agent_env.py): MultiAgentEnv protocol, policy mapping,
+shared + independent learner modes, per-policy metrics."""
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib.multi_agent import (MultiAgentBatch, MultiAgentEnv,
+                                       MultiAgentEnvRunner, module_specs_for)
+
+
+class MatchGame(MultiAgentEnv):
+    """Cooperative 2-agent game: both see the same random target in {0,1};
+    each gets +1 for picking the target, and a +1 bonus each when BOTH do.
+    Optimal joint return = 4/step; random play averages 1.5/step."""
+
+    def __init__(self, episode_len=16, seed=0):
+        import gymnasium as gym
+        self.possible_agents = ["a0", "a1"]
+        self.observation_spaces = {
+            a: gym.spaces.Box(-1.0, 1.0, (2,), np.float32)
+            for a in self.possible_agents}
+        self.action_spaces = {a: gym.spaces.Discrete(2)
+                              for a in self.possible_agents}
+        self.episode_len = episode_len
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._target = 0
+
+    def _obs(self):
+        onehot = np.zeros(2, np.float32)
+        onehot[self._target] = 1.0
+        return {a: onehot.copy() for a in self.possible_agents}
+
+    def reset(self, *, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._t = 0
+        self._target = int(self._rng.integers(2))
+        return self._obs(), {a: {} for a in self.possible_agents}
+
+    def step(self, action_dict):
+        correct = {a: int(action_dict[a]) == self._target
+                   for a in self.possible_agents}
+        bonus = 1.0 if all(correct.values()) else 0.0
+        rewards = {a: float(correct[a]) + bonus for a in self.possible_agents}
+        self._t += 1
+        self._target = int(self._rng.integers(2))
+        done = self._t >= self.episode_len
+        terms = {a: done for a in self.possible_agents}
+        terms["__all__"] = done
+        truncs = {a: False for a in self.possible_agents}
+        truncs["__all__"] = False
+        return self._obs(), rewards, terms, truncs, \
+            {a: {} for a in self.possible_agents}
+
+
+def _runner(mapping, rollout_len=32):
+    from ray_tpu.rllib.rl_module import RLModule
+    env_creator = lambda: MatchGame()
+    specs = module_specs_for(MatchGame(), mapping, hiddens=(32,))
+    modules = {pid: RLModule(s) for pid, s in specs.items()}
+    return MultiAgentEnvRunner(env_creator, policy_mapping_fn=mapping,
+                               modules=modules, rollout_len=rollout_len)
+
+
+def test_runner_shapes_and_per_policy_batches():
+    mapping = lambda aid: aid  # independent: one policy per agent
+    runner = _runner(mapping)
+    params = runner.init_params()
+    ma_batch, metrics = runner.sample(params)
+    assert isinstance(ma_batch, MultiAgentBatch)
+    assert sorted(ma_batch.keys()) == ["a0", "a1"]
+    for pid in ("a0", "a1"):
+        b = ma_batch[pid]
+        assert b["obs"].shape == (32, 1, 2)
+        assert b["rewards"].shape == (32, 1)
+        assert b["bootstrap_value"].shape == (1,)
+    assert ma_batch.env_steps() == 32
+    assert ma_batch.agent_steps() == 64
+    assert metrics["episodes_this_iter"] == 2  # 32 steps / 16-step episodes
+
+
+def test_shared_policy_batches_agents_together():
+    mapping = lambda aid: "shared"
+    runner = _runner(mapping)
+    params = runner.init_params()
+    ma_batch, _ = runner.sample(params)
+    assert sorted(ma_batch.keys()) == ["shared"]
+    assert ma_batch["shared"]["obs"].shape == (32, 2, 2)  # both agents rows
+
+
+def test_unknown_policy_mapping_raises():
+    with pytest.raises(KeyError, match="not in"):
+        from ray_tpu.rllib.rl_module import RLModule
+        specs = module_specs_for(MatchGame(), lambda a: "p", hiddens=(16,))
+        MultiAgentEnvRunner(lambda: MatchGame(),
+                            policy_mapping_fn=lambda a: "other",
+                            modules={"p": RLModule(specs["p"])})
+
+
+def _train_ppo(mapping, policies, iters=10):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    algo = (PPOConfig()
+            .environment(lambda: MatchGame())
+            .multi_agent(policies=policies, policy_mapping_fn=mapping)
+            .training(train_batch_size=256, minibatch_size=64,
+                      num_epochs=4, lr=1e-2, entropy_coeff=0.01)
+            .env_runners(rollout_fragment_length=64)
+            .build())
+    best, last = -np.inf, None
+    for _ in range(iters):
+        result = algo.train()
+        best = max(best, result.get("episode_return_mean", -np.inf))
+        last = result
+    algo.stop()
+    return best, last
+
+
+def test_ppo_multi_agent_shared_mode_learns():
+    best, last = _train_ppo(lambda aid: "shared", ["shared"])
+    assert sorted(last["learner"].keys()) == ["shared"]
+    assert np.isfinite(last["learner"]["shared"]["total_loss"])
+    # optimal 4/step * 16 steps = 64; random ~24. Demand clear improvement.
+    assert best > 40, f"shared-mode PPO failed to learn: best={best}"
+
+
+def test_ppo_multi_agent_independent_mode_learns():
+    best, last = _train_ppo(lambda aid: aid, ["a0", "a1"])
+    assert sorted(last["learner"].keys()) == ["a0", "a1"]
+    for pid in ("a0", "a1"):
+        assert np.isfinite(last["learner"][pid]["total_loss"])
+    assert best > 40, f"independent-mode PPO failed to learn: best={best}"
