@@ -125,6 +125,30 @@ class DriverClient(BaseClient):
         except RuntimeError:
             pass
 
+    def actor_incref(self, actor_id):
+        try:
+            self.loop.call_soon_threadsafe(self.controller.actor_incref, actor_id)
+        except RuntimeError:
+            pass
+
+    def actor_decref(self, actor_id):
+        try:
+            self.loop.call_soon_threadsafe(self.controller.actor_decref, actor_id)
+        except RuntimeError:
+            pass  # loop already closed at shutdown
+
+    def open_stream(self, task_id):
+        try:
+            self.loop.call_soon_threadsafe(self.controller.open_stream, task_id)
+        except RuntimeError:
+            pass
+
+    def close_stream(self, task_id):
+        try:
+            self.loop.call_soon_threadsafe(self.controller.close_stream, task_id)
+        except RuntimeError:
+            pass
+
     def resources(self):
         return (self._call_soon(lambda: dict(self.controller.total)),
                 self._call_soon(lambda: dict(self.controller.available)))
@@ -317,6 +341,30 @@ class WorkerClient(BaseClient):
     def incref(self, oid):
         try:
             self._send("incref", oids=[oid])
+        except OSError:
+            pass
+
+    def actor_incref(self, actor_id):
+        try:
+            self._send("actor_incref", actor_id=actor_id)
+        except OSError:
+            pass
+
+    def actor_decref(self, actor_id):
+        try:
+            self._send("actor_decref", actor_id=actor_id)
+        except OSError:
+            pass
+
+    def open_stream(self, task_id):
+        try:
+            self._send("open_stream", task_id=task_id)
+        except OSError:
+            pass
+
+    def close_stream(self, task_id):
+        try:
+            self._send("close_stream", task_id=task_id)
         except OSError:
             pass
 

@@ -59,12 +59,17 @@ class TaskRecord:
     ts_start: float = 0.0
     ts_end: float = 0.0
     cancelled: bool = False
+    pinned_actors: List[str] = field(default_factory=list)
+    pinned_streams: List[str] = field(default_factory=list)
 
 
 @dataclass
 class StreamState:
     items: list = field(default_factory=list)  # object ids in yield order
     finished: bool = False
+    drained: bool = False  # consumer saw the end (StopIteration / error)
+    open_handles: int = 0  # live ObjectRefGenerator copies
+    max_served: int = 0  # items[:max_served] were handed out (consumer owns them)
     error: Optional[Exception] = None
     cond: asyncio.Event = field(default_factory=asyncio.Event)
 
@@ -84,6 +89,10 @@ class WorkerConn:
     # platform library can block on the chip while another process computes,
     # so plain workers must never touch it)
     tpu_capable: bool = False
+    # actor handle / stream refs this worker's deserialized handles hold;
+    # reconciled (released) if the worker dies without the matching decrefs
+    actor_refs: Dict[str, int] = field(default_factory=dict)
+    stream_refs: Dict[str, int] = field(default_factory=dict)
 
 
 @dataclass
@@ -101,6 +110,12 @@ class ActorRecord:
     death_reason: str = ""
     env: dict = field(default_factory=dict)
     resources_claimed: bool = False  # standing allocation held (exactly-once release)
+    # distributed handle refcount (ref: Ray's actor handle reference counting,
+    # src/ray/core_worker/reference_count.cc — an actor with no reachable
+    # handles is terminated). Starts at 1 for the creating handle; serialized
+    # handles ride the contained-id lists, deserialized handles own a ref.
+    handle_refs: int = 1
+    pending_gc: bool = False  # refs hit 0 while tasks were still queued/running
 
 
 @dataclass
@@ -148,7 +163,19 @@ class Controller:
         self.tpu_free: List[int] = list(range(int(resources.get("TPU", 0))))
         self._server = None
         self._shutdown = False
-        self.timeline_events: List[dict] = []
+        # Bounded bookkeeping (ref: GCS job-level GC,
+        # src/ray/gcs/gcs_server/gcs_task_manager.h RAY_maximum_gcs_storage_entries):
+        # finished task records and timeline events are pruned so week-long
+        # sessions hold steady memory. Slim (spec, result_oids) pairs survive
+        # pruning in `lineage_specs` so object reconstruction keeps working.
+        self.task_retention = int(os.environ.get("RAY_TPU_TASK_RETENTION", "1000"))
+        self.lineage_retention = int(os.environ.get("RAY_TPU_LINEAGE_RETENTION", "10000"))
+        self.dead_actor_retention = int(os.environ.get("RAY_TPU_DEAD_ACTOR_RETENTION", "512"))
+        self._done_task_ids: collections.deque = collections.deque()
+        self._dead_actor_ids: collections.deque = collections.deque()
+        self.lineage_specs: "collections.OrderedDict[str, tuple]" = collections.OrderedDict()
+        self.timeline_events: collections.deque = collections.deque(
+            maxlen=int(os.environ.get("RAY_TPU_TIMELINE_RETENTION", "20000")))
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -250,20 +277,44 @@ class Controller:
         elif kind == "unblocked":
             self._on_unblocked(w, p["task_id"])
         elif kind == "decref":
-            self.decref(p["oids"])
+            for oid in p["oids"]:
+                if oid.startswith("actor-"):
+                    self._worker_actor_decref(w, oid)
+                elif oid.startswith("task-"):
+                    self._worker_close_stream(w, oid)
+                else:
+                    self.decref([oid])
         elif kind == "incref":
-            self.incref(p["oids"])
+            for oid in p["oids"]:
+                if oid.startswith("actor-"):
+                    self._worker_actor_incref(w, oid)
+                elif oid.startswith("task-"):
+                    self._worker_open_stream(w, oid)
+                else:
+                    self.incref([oid])
+        elif kind == "actor_incref":
+            self._worker_actor_incref(w, p["actor_id"])
+        elif kind == "actor_decref":
+            self._worker_actor_decref(w, p["actor_id"])
+        elif kind == "open_stream":
+            self._worker_open_stream(w, p["task_id"])
+        elif kind == "close_stream":
+            self._worker_close_stream(w, p["task_id"])
         elif kind == "next_stream":
             self.loop.create_task(self._worker_next_stream(w, p))
         elif kind == "register_actor_rpc":
             try:
                 aid = self.register_actor(p["spec"], p["options"])
+                # the creating handle (handle_refs' initial 1) lives in this
+                # worker — tally it so a crash releases it
+                w.actor_refs[aid] = w.actor_refs.get(aid, 0) + 1
                 self._reply(w, p["req_id"], actor_id=aid)
             except ValueError as e:
                 self._reply(w, p["req_id"], error=e)
         elif kind == "get_actor":
             try:
                 aid = self.lookup_actor(p["name"], p.get("namespace"))
+                w.actor_refs[aid] = w.actor_refs.get(aid, 0) + 1
                 self._reply(w, p["req_id"], actor_id=aid)
             except ValueError as e:
                 self._reply(w, p["req_id"], error=e)
@@ -327,6 +378,12 @@ class Controller:
         rec = TaskRecord(spec=spec, result_oids=result_oids,
                         retries_left=retries, ts_submit=time.time())
         self.tasks[spec.task_id] = rec
+        if spec.actor_id and not spec.is_actor_creation:
+            # a submitted method pins its target: the caller may drop its
+            # handle while this task is still waiting on deps, and the actor
+            # must not be GC'd out from under it (released in _unpin)
+            self.actor_incref(spec.actor_id)
+            rec.pinned_actors.append(spec.actor_id)
         # dependency tracking: top-level ref args must be local before dispatch.
         # Pin every ref arg for the task's lifetime so caller-side GC of the
         # ObjectRef can't evict an argument in flight (ref: task specs hold
@@ -341,8 +398,21 @@ class Controller:
                     rec.deps_remaining.add(v)
                     self.dep_waiters[v].add(spec.task_id)
         # refs buried inside inline arg values: pin (alive) but don't treat as
-        # dispatch deps — the task body fetches them itself if it wants them
+        # dispatch deps — the task body fetches them itself if it wants them.
+        # Actor handles ride the same list (prefix dispatch): the actor stays
+        # alive until the task finishes, by which point the worker's
+        # deserialized handle holds its own ref.
         for v in spec.nested_refs:
+            if v.startswith("actor-"):
+                self.actor_incref(v)
+                rec.pinned_actors.append(v)
+                continue
+            if v.startswith("task-"):
+                # a generator handle in the args keeps its stream open until
+                # the task finishes (released via _unpin's pinned_streams)
+                self.open_stream(v)
+                rec.pinned_streams.append(v)
+                continue
             meta = self.objects.get(v)
             if meta is not None:
                 meta.pinned += 1
@@ -605,6 +675,8 @@ class Controller:
                 self._fail_actor(actor, f"creation failed: {err}", allow_restart=False)
             self._release_task_resources(rec)
             self._schedule()
+            if actor is not None and actor.pending_gc:
+                self._maybe_gc_actor(actor)
             return
         # success: record result objects
         for oid, meta_len, size, inline, contained in p["results"]:
@@ -614,8 +686,10 @@ class Controller:
             if st:
                 st.finished = True
                 st.cond.set()
+                self._maybe_drop_stream(task_id, st)  # already abandoned?
         rec.state = DONE
         rec.done.set()
+        self._mark_task_terminal(rec)
         if spec.is_actor_creation and actor is not None:
             if actor.state == A_DEAD:
                 # killed while creation was in flight: don't resurrect
@@ -626,6 +700,8 @@ class Controller:
         self._release_task_resources(rec)
         self._unpin(rec)
         self._schedule()
+        if actor is not None and actor.pending_gc:
+            self._maybe_gc_actor(actor)
 
     def _release_task_resources(self, rec: TaskRecord):
         if rec.spec.actor_id:
@@ -654,9 +730,49 @@ class Controller:
                 if meta.refcount <= 0 and meta.pinned == 0:
                     self._evict(oid)
         rec.pinned.clear()
+        for aid in rec.pinned_actors:
+            self.actor_decref(aid)
+        rec.pinned_actors.clear()
+        for sid in rec.pinned_streams:
+            self.close_stream(sid)
+        rec.pinned_streams.clear()
+
+    # ---------------------------------------------------------------- task GC
+    def _mark_task_terminal(self, rec: TaskRecord):
+        """Queue a finished task record for pruning. Actor creation records are
+        exempt while their actor lives (restart paths index them directly)."""
+        if rec.spec.is_actor_creation:
+            return
+        self._done_task_ids.append(rec.spec.task_id)
+        self._gc_tasks()
+
+    def _gc_tasks(self):
+        while len(self._done_task_ids) > self.task_retention:
+            tid = self._done_task_ids.popleft()
+            rec = self.tasks.get(tid)
+            if rec is None:
+                continue
+            if rec.state not in (DONE, FAILED, CANCELLED):
+                continue  # resurrected by lineage recovery; re-queued on redo
+            spec = rec.spec
+            if not spec.actor_id and spec.num_returns != "streaming" and rec.state == DONE:
+                # keep the slim spec (plus the remaining reconstruction budget
+                # — resurrection must not re-grant an exhausted one) so
+                # reconstruction stays possible after the record is dropped
+                self.lineage_specs[tid] = (spec, list(rec.result_oids),
+                                           rec.reconstructions_left)
+                while len(self.lineage_specs) > self.lineage_retention:
+                    self.lineage_specs.popitem(last=False)
+            del self.tasks[tid]
+            st = self.streams.get(tid)
+            if st is not None:
+                self._maybe_drop_stream(tid, st)
 
     def _fail_task(self, rec: TaskRecord, err: Exception):
+        was_terminal = rec.state in (DONE, FAILED, CANCELLED)
         rec.state = CANCELLED if isinstance(err, exc.TaskCancelledError) else FAILED
+        if not was_terminal:
+            self._mark_task_terminal(rec)
         self._unpin(rec)
         for oid in rec.result_oids:
             meta = self.objects.get(oid)
@@ -817,6 +933,14 @@ class Controller:
 
     def decref(self, oids: List[str]):
         for oid in oids:
+            if oid.startswith("actor-"):
+                # contained-id lists carry actor handles and generator
+                # task-ids too (prefix dispatch)
+                self.actor_decref(oid)
+                continue
+            if oid.startswith("task-"):
+                self.close_stream(oid)
+                continue
             meta = self.objects.get(oid)
             if meta is None:
                 continue
@@ -826,9 +950,65 @@ class Controller:
 
     def incref(self, oids: List[str]):
         for oid in oids:
+            if oid.startswith("actor-"):
+                self.actor_incref(oid)
+                continue
+            if oid.startswith("task-"):
+                self.open_stream(oid)
+                continue
             meta = self.objects.get(oid)
             if meta is not None:
                 meta.refcount += 1
+
+    # -------------------------------------------------- actor handle refcount
+    def _worker_actor_incref(self, w: WorkerConn, actor_id: str):
+        """Handle ref held by code inside worker `w` — tallied per worker so a
+        crash releases it (ref: reference_count.cc borrower reconciliation)."""
+        self.actor_incref(actor_id)
+        w.actor_refs[actor_id] = w.actor_refs.get(actor_id, 0) + 1
+
+    def _worker_actor_decref(self, w: WorkerConn, actor_id: str):
+        n = w.actor_refs.get(actor_id, 0)
+        if n <= 1:
+            w.actor_refs.pop(actor_id, None)
+        else:
+            w.actor_refs[actor_id] = n - 1
+        self.actor_decref(actor_id)
+
+    def actor_incref(self, actor_id: str):
+        actor = self.actors.get(actor_id)
+        if actor is not None and actor.state != A_DEAD:
+            actor.handle_refs += 1
+            actor.pending_gc = False
+
+    def actor_decref(self, actor_id: str):
+        actor = self.actors.get(actor_id)
+        if actor is None or actor.state == A_DEAD:
+            return
+        actor.handle_refs -= 1
+        if actor.handle_refs <= 0:
+            self._maybe_gc_actor(actor)
+
+    def _maybe_gc_actor(self, actor: ActorRecord):
+        """Terminate an actor no handle can reach any more (ref: Ray GCs
+        non-detached actors when all handles go out of scope,
+        src/ray/gcs/gcs_server/gcs_actor_manager.cc OnActorOutOfScope).
+        Named and detached actors are exempt: they die only via kill() or
+        shutdown. Queued/in-flight work finishes first — the GC re-fires from
+        _on_task_done when the actor drains."""
+        if actor.handle_refs > 0 or actor.state == A_DEAD:
+            return
+        if actor.name or (actor.options is not None and
+                          getattr(actor.options, "lifetime", None) == "detached"):
+            return
+        # cancelled/failed records linger in the queue until _schedule pops
+        # them — only live work defers collection
+        if actor.in_flight or any(r.state == PENDING for r in actor.queue):
+            actor.pending_gc = True
+            return
+        actor.pending_gc = False
+        self.kill_actor(actor.actor_id, no_restart=True,
+                        reason="all handles out of scope")
 
     def _evict(self, oid: str):
         meta = self.objects.pop(oid, None)
@@ -860,6 +1040,16 @@ class Controller:
         meta = self.objects.get(oid)
         tid = meta.creating_task if meta is not None else self.lineage.get(oid)
         rec = self.tasks.get(tid) if tid else None
+        if rec is None and tid in self.lineage_specs:
+            # record was GC'd; resurrect a slim DONE record from the kept spec
+            spec, roids, budget = self.lineage_specs[tid]
+            rec = TaskRecord(spec=spec, result_oids=roids, state=DONE)
+            rec.reconstructions_left = budget
+            rec.done.set()
+            self.tasks[tid] = rec
+            # re-enroll for pruning — a probe that aborts recovery must not
+            # leave an immortal record behind
+            self._mark_task_terminal(rec)
         if rec is None:
             return None
         spec = rec.spec
@@ -947,10 +1137,13 @@ class Controller:
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             if index < len(st.items):
+                st.max_served = max(st.max_served, index + 1)
                 return st.items[index]
             if st.error is not None:
+                self._mark_stream_drained(task_id, st)
                 raise st.error if isinstance(st.error, Exception) else exc.TaskError("stream", str(st.error))
             if st.finished:
+                self._mark_stream_drained(task_id, st)
                 return None  # StopIteration sentinel
             st.cond.clear()
             remaining = None if deadline is None else deadline - time.monotonic()
@@ -958,6 +1151,50 @@ class Controller:
                 await asyncio.wait_for(st.cond.wait(), remaining)
             except asyncio.TimeoutError:
                 raise exc.GetTimeoutError("stream next() timed out") from None
+
+    def _maybe_drop_stream(self, task_id: str, st: StreamState):
+        """Single deletion rule: the producer finished, a consumer saw the end
+        (or every handle is gone), and no generator copy remains open. Items
+        never handed to a consumer drop the register_put refcount no consumer
+        ObjectRef will ever balance."""
+        if st.finished and st.drained and st.open_handles <= 0:
+            if self.streams.pop(task_id, None) is not None:
+                self.decref(st.items[st.max_served:])
+
+    def _mark_stream_drained(self, task_id: str, st: StreamState):
+        st.drained = True
+        self._maybe_drop_stream(task_id, st)
+
+    def open_stream(self, task_id: str):
+        st = self.streams.get(task_id)
+        if st is not None:
+            st.open_handles += 1
+
+    def _worker_open_stream(self, w: WorkerConn, task_id: str):
+        if task_id in self.streams:
+            w.stream_refs[task_id] = w.stream_refs.get(task_id, 0) + 1
+        self.open_stream(task_id)
+
+    def _worker_close_stream(self, w: WorkerConn, task_id: str):
+        n = w.stream_refs.get(task_id, 0)
+        if n <= 1:
+            w.stream_refs.pop(task_id, None)
+        else:
+            w.stream_refs[task_id] = n - 1
+        self.close_stream(task_id)
+
+    def close_stream(self, task_id: str):
+        """A generator handle was GC'd. Only when the LAST copy goes (a copy in
+        a worker must not tear the stream down under the driver's iterator) is
+        an abandoned stream's buffered state released."""
+        st = self.streams.get(task_id)
+        if st is None:
+            return
+        st.open_handles -= 1
+        if st.open_handles > 0:
+            return
+        st.drained = True
+        self._maybe_drop_stream(task_id, st)
 
     # ------------------------------------------------------------------ actors
     def register_actor(self, spec: TaskSpec, options) -> str:
@@ -977,9 +1214,11 @@ class Controller:
         aid = self.named_actors.get(key)
         if aid is None or self.actors[aid].state == A_DEAD:
             raise ValueError(f"Failed to look up actor '{name}' in namespace '{key[0]}'")
+        self.actor_incref(aid)  # the handle about to be built owns this ref
         return aid
 
-    def kill_actor(self, actor_id: str, no_restart: bool = True):
+    def kill_actor(self, actor_id: str, no_restart: bool = True,
+                   reason: str = "killed via kill()"):
         actor = self.actors.get(actor_id)
         if actor is None:
             return
@@ -991,9 +1230,11 @@ class Controller:
                 self._kill_worker_proc(sw)
         if no_restart:
             actor.restarts_used = actor.options.max_restarts + 1 if actor.options else 1
-        self._fail_actor(actor, "killed via kill()", allow_restart=not no_restart)
+        self._fail_actor(actor, reason, allow_restart=not no_restart)
 
     def _fail_actor(self, actor: ActorRecord, reason: str, allow_restart: bool):
+        if actor.state == A_DEAD:
+            return
         can_restart = (allow_restart and actor.options is not None and
                        (actor.options.max_restarts == -1 or
                         actor.restarts_used < actor.options.max_restarts))
@@ -1009,6 +1250,8 @@ class Controller:
             # carry the arg/nested-ref pins submit() took — the replaced rec
             # would otherwise leak them (its _unpin never runs)
             rec.pinned, old_rec.pinned = old_rec.pinned, []
+            rec.pinned_actors, old_rec.pinned_actors = old_rec.pinned_actors, []
+            rec.pinned_streams, old_rec.pinned_streams = old_rec.pinned_streams, []
             self.tasks[cspec.task_id] = rec
             self._spawn_worker(actor)
             rec.state = "SPAWNING"
@@ -1032,6 +1275,16 @@ class Controller:
             crec = self.tasks.get(actor.creation_spec.task_id)
             if crec is not None and crec.state not in (DONE, FAILED, CANCELLED):
                 self._fail_task(crec, err)
+            # final death: the creation record (exempt from normal GC while the
+            # actor lived — restart paths index it) can now be pruned
+            self._done_task_ids.append(actor.creation_spec.task_id)
+        self._dead_actor_ids.append(actor.actor_id)
+        while len(self._dead_actor_ids) > self.dead_actor_retention:
+            old = self._dead_actor_ids.popleft()
+            stale = self.actors.get(old)
+            if stale is not None and stale.state == A_DEAD:
+                del self.actors[old]
+        self._gc_tasks()
         self._release_actor_allocation(actor)
 
     def _on_worker_dead(self, w: WorkerConn, reason: str):
@@ -1082,6 +1335,16 @@ class Controller:
             actor = self.actors.get(w.actor_id)
             if actor is not None and actor.state in (A_ALIVE, A_PENDING):
                 self._fail_actor(actor, f"worker died: {reason}", allow_restart=True)
+        # release handle/stream refs the dead worker's deserialized handles
+        # held — a crash must not pin other actors or streams alive forever
+        for aid, n in list(w.actor_refs.items()):
+            for _ in range(n):
+                self.actor_decref(aid)
+        w.actor_refs.clear()
+        for sid, n in list(w.stream_refs.items()):
+            for _ in range(n):
+                self.close_stream(sid)
+        w.stream_refs.clear()
 
     # ----------------------------------------------------------- cancel / kill
     def cancel(self, task_id: str, force: bool = False):
@@ -1098,6 +1361,13 @@ class Controller:
                 self.ready_queue.remove(rec)
             except ValueError:
                 pass
+            if rec.spec.actor_id and not rec.spec.is_actor_creation:
+                actor = self.actors.get(rec.spec.actor_id)
+                if actor is not None:
+                    try:
+                        actor.queue.remove(rec)
+                    except ValueError:
+                        pass
         elif rec.state == RUNNING:
             w = self.workers.get(rec.worker_id)
             if w is None:
@@ -1177,10 +1447,13 @@ class Controller:
                      "restarts": a.restarts_used}
                     for a in self.actors.values()]
         if kind == "tasks":
+            # most-recent first: callers pass a limit, and the freshest tasks
+            # are the ones a `list_tasks()` right after a submit must surface
             return [{"task_id": t.spec.task_id, "name": t.spec.name, "state": t.state,
                      "worker_id": t.worker_id,
                      "duration_s": (t.ts_end - t.ts_start) if t.ts_end else None}
-                    for t in self.tasks.values()]
+                    for t in sorted(self.tasks.values(),
+                                    key=lambda t: t.ts_submit, reverse=True)]
         if kind == "objects":
             return [{"object_id": o.object_id, "size": o.size, "location": o.location,
                      "refcount": o.refcount, "pinned": o.pinned}

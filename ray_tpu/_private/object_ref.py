@@ -83,6 +83,13 @@ class ObjectRefGenerator:
     def __init__(self, task_id: str):
         self.task_id = task_id
         self._index = 0
+        try:
+            from . import state
+            client = state.global_client_or_none()
+            if client is not None:
+                client.open_stream(task_id)
+        except Exception:  # noqa: BLE001
+            pass
 
     def __iter__(self):
         return self
@@ -107,7 +114,22 @@ class ObjectRefGenerator:
             raise StopAsyncIteration from None
 
     def __reduce__(self):
+        # in-transit hold: the containing object/task keeps the stream open
+        # until the receiver's own open_stream lands (prefix-dispatched like
+        # nested ObjectRefs / actor handles)
+        from . import serialization
+        serialization.note_contained_ref(self.task_id)
         return (ObjectRefGenerator, (self.task_id,))
+
+    def __del__(self):
+        # abandoning a half-iterated stream releases its buffered state
+        try:
+            from . import state
+            client = state.global_client_or_none()
+            if client is not None:
+                client.close_stream(self.task_id)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
 
 DynamicObjectRefGenerator = ObjectRefGenerator

@@ -48,7 +48,24 @@ class ActorMethod:
         raise TypeError(f"Actor method '{self._name}' must be called with .remote().")
 
 
+def _reconstruct_handle(actor_id, method_meta, name):
+    """Deserialization path: the new handle owns a fresh controller-side ref
+    (ref: Ray's handle refcounting — each deserialized copy registers as a
+    borrower, reference_count.cc). The serialized bytes' own hold rides the
+    contained-id lists (see __reduce__), so the actor can't die in transit."""
+    client = state.global_client_or_none()
+    if client is not None:
+        client.actor_incref(actor_id)
+    return ActorHandle(actor_id, method_meta, name=name)
+
+
 class ActorHandle:
+    """A reference to a live actor. Every constructed handle owns one
+    controller-side `handle_refs` count, released in __del__; when the count
+    hits zero an anonymous (unnamed, non-detached) actor is garbage-collected
+    and its worker process reclaimed (ref: python/ray/actor.py ActorHandle +
+    gcs_actor_manager.cc OnActorOutOfScope)."""
+
     def __init__(self, actor_id, method_meta, name=""):
         self._actor_id = actor_id
         self._method_meta = method_meta  # {name: {"num_returns": n}}
@@ -84,7 +101,19 @@ class ActorHandle:
         return refs[0] if num_returns == 1 else refs
 
     def __reduce__(self):
-        return (ActorHandle, (self._actor_id, self._method_meta, self._name))
+        # record the handle in the active serialization's contained-id list
+        # (prefix-dispatched next to nested ObjectRefs): the containing
+        # object/task pins the actor until the bytes are consumed
+        serialization.note_contained_ref(self._actor_id)
+        return (_reconstruct_handle, (self._actor_id, self._method_meta, self._name))
+
+    def __del__(self):
+        try:
+            client = state.global_client_or_none()
+            if client is not None:
+                client.actor_decref(self._actor_id)
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
 
     def __repr__(self):
         return f"ActorHandle({self._actor_id})"

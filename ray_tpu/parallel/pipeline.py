@@ -65,13 +65,13 @@ def pipeline_apply(
         outs = jnp.where(is_last, outs, jnp.zeros_like(outs))
         return jax.lax.psum(outs, axis)
 
-    from jax.experimental.shard_map import shard_map
+    shard_map = jax.shard_map
 
     return shard_map(
         per_device, mesh=mesh,
         in_specs=(P(axis), P()),  # stages sharded; microbatches replicated
         out_specs=P(),
-        check_rep=False,
+        check_vma=False,
     )(stage_params, microbatches)
 
 
