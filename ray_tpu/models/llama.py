@@ -203,11 +203,16 @@ class Llama(nn.Module):
     cfg: LlamaConfig
 
     @nn.compact
-    def __call__(self, tokens, positions=None, cache: Optional[KVCache] = None):
+    def __call__(self, tokens, positions=None, cache: Optional[KVCache] = None,
+                 return_hidden: bool = False):
         """tokens [B, T] int32 → logits [B, T, V] (f32), new cache (or None).
 
         Prefill/train: cache=None, full causal attention. Decode: pass a
-        KVCache; T is the number of new tokens (usually 1)."""
+        KVCache; T is the number of new tokens (usually 1).
+
+        `return_hidden=True` returns the final-norm hidden states [B, T, D]
+        instead of logits — callers fuse the lm_head into a chunked loss
+        (ops.losses.chunked_cross_entropy) to avoid materializing [B, T, V]."""
         cfg = self.cfg
         b, t = tokens.shape
         if positions is None:
@@ -234,6 +239,12 @@ class Llama(nn.Module):
                 new_v.append(new_kv[1])
 
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
+        if return_hidden:
+            new_cache = None
+            if cache is not None:
+                new_cache = KVCache(k=tuple(new_k), v=tuple(new_v),
+                                    length=cache.length + t)
+            return x, new_cache
         if cfg.tie_embeddings:
             logits = embed.attend(x)
         else:

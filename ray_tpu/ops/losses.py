@@ -44,6 +44,50 @@ def cross_entropy(
         "loss": loss, "z_loss": zterm, "accuracy": acc, "tokens": jnp.sum(mask)}
 
 
+def chunked_cross_entropy(
+    hidden: jax.Array,   # [B, T, D] final hidden states (pre-lm_head)
+    w_head: jax.Array,   # [D, V] lm_head kernel
+    labels: jax.Array,   # [B, T] int
+    chunk_size: int = 512,
+):
+    """Cross-entropy fused with the lm_head, computed per sequence chunk.
+
+    The full [B, T, V] f32 logits tensor is the single largest activation in
+    LLM training (llama_1b @ B=8, T=2048: ~4 GB with softmax intermediates) —
+    the classic memory wall the reference hits with torch fused CE kernels.
+    Here each chunk's logits are produced, reduced, and (via jax.checkpoint)
+    recomputed in the backward, so peak logits memory is B·chunk·V instead of
+    B·T·V. FLOPs are unchanged; only the head matmul is recomputed once.
+
+    Supports the dense-LM subset of `cross_entropy`: no mask / z_loss /
+    label_smoothing (use `cross_entropy` on full logits for those). Returns
+    (mean_loss, {"loss", "accuracy", "tokens"}).
+    """
+    b, t, d = hidden.shape
+    assert t % chunk_size == 0, (t, chunk_size)
+    nc = t // chunk_size
+    h = hidden.reshape(b, nc, chunk_size, d).swapaxes(0, 1)   # [nc, B, c, D]
+    y = labels.reshape(b, nc, chunk_size).swapaxes(0, 1)      # [nc, B, c]
+
+    @jax.checkpoint
+    def body(carry, hy):
+        nll_sum, acc_sum = carry
+        h_c, y_c = hy
+        logits = jax.lax.dot_general(
+            h_c, w_head, (((2,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)               # [B, c, V]
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        label_logits = jnp.take_along_axis(logits, y_c[..., None], axis=-1)[..., 0]
+        nll = lse - label_logits
+        hits = jnp.sum(jnp.argmax(logits, -1) == y_c)
+        return (nll_sum + jnp.sum(nll), acc_sum + hits), None
+
+    (nll_sum, hits), _ = jax.lax.scan(body, (jnp.float32(0.0), jnp.int32(0)), (h, y))
+    n = b * t
+    loss = nll_sum / n
+    return loss, {"loss": loss, "accuracy": hits / n, "tokens": n}
+
+
 def gae(
     rewards: jax.Array,   # [T] or [T, B]
     values: jax.Array,    # [T+1] or [T+1, B] (bootstrap value appended)

@@ -166,3 +166,43 @@ class TestLosses:
     def test_huber(self):
         x = jnp.array([-2.0, 0.5, 2.0])
         np.testing.assert_allclose(losses.huber(x), [1.5, 0.125, 1.5])
+
+
+class TestChunkedCrossEntropy:
+    def test_matches_full_cross_entropy(self):
+        from ray_tpu.ops.losses import chunked_cross_entropy, cross_entropy
+        key = jax.random.PRNGKey(0)
+        B, T, D, V = 2, 128, 32, 97
+        hidden = jax.random.normal(key, (B, T, D), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(1), (D, V), jnp.float32) * 0.05
+        labels = jax.random.randint(jax.random.PRNGKey(2), (B, T), 0, V)
+        full, m_full = cross_entropy(hidden @ w, labels)
+        chunked, m_chunk = chunked_cross_entropy(hidden, w, labels, chunk_size=32)
+        np.testing.assert_allclose(chunked, full, rtol=1e-5)
+        np.testing.assert_allclose(m_chunk["accuracy"], m_full["accuracy"], rtol=1e-5)
+
+    def test_grads_match(self):
+        from ray_tpu.ops.losses import chunked_cross_entropy, cross_entropy
+        key = jax.random.PRNGKey(3)
+        B, T, D, V = 2, 64, 16, 31
+        hidden = jax.random.normal(key, (B, T, D), jnp.float32)
+        w = jax.random.normal(jax.random.PRNGKey(4), (D, V), jnp.float32) * 0.1
+        labels = jax.random.randint(jax.random.PRNGKey(5), (B, T), 0, V)
+        g_full = jax.grad(lambda h, w: cross_entropy(h @ w, labels)[0], argnums=(0, 1))(hidden, w)
+        g_chunk = jax.grad(lambda h, w: chunked_cross_entropy(h, w, labels, chunk_size=16)[0],
+                           argnums=(0, 1))(hidden, w)
+        for a, b in zip(g_chunk, g_full):
+            np.testing.assert_allclose(a, b, atol=1e-5)
+
+    def test_model_return_hidden_consistent(self):
+        from ray_tpu.models.llama import Llama, LlamaConfig
+        cfg = LlamaConfig.tiny(max_seq_len=32)
+        model = Llama(cfg)
+        tokens = jax.random.randint(jax.random.PRNGKey(0), (2, 16), 0, cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(1), tokens)
+        logits, _ = model.apply(params, tokens)
+        hidden, _ = model.apply(params, tokens, return_hidden=True)
+        w = params["params"]["lm_head"]["kernel"]
+        np.testing.assert_allclose(
+            np.asarray(hidden.astype(jnp.float32)) @ np.asarray(w, dtype=np.float32),
+            logits, atol=2e-2)
