@@ -153,6 +153,14 @@ class DriverClient(BaseClient):
         return (self._call_soon(lambda: dict(self.controller.total)),
                 self._call_soon(lambda: dict(self.controller.available)))
 
+    def object_sizes(self, oids):
+        """Registered byte sizes (0 for unknown ids) — cheap metadata read used
+        by the data streaming executor's memory accounting."""
+        def read():
+            return [self.controller.objects[o].size
+                    if o in self.controller.objects else 0 for o in oids]
+        return self._call_soon(read)
+
     def state(self, kind):
         return self._call_soon(self.controller.state_snapshot, kind)
 
@@ -371,6 +379,9 @@ class WorkerClient(BaseClient):
     def resources(self):
         p = self._rpc("resources")
         return p["total"], p["available"]
+
+    def object_sizes(self, oids):
+        return self._rpc("obj_sizes", oids=oids)["sizes"]
 
     def state(self, kind):
         raise NotImplementedError("state API is driver-only in round 1")

@@ -296,6 +296,10 @@ class Controller:
             self._worker_actor_incref(w, p["actor_id"])
         elif kind == "actor_decref":
             self._worker_actor_decref(w, p["actor_id"])
+        elif kind == "obj_sizes":
+            self._reply(w, p["req_id"], sizes=[
+                self.objects[o].size if o in self.objects else 0
+                for o in p["oids"]])
         elif kind == "open_stream":
             self._worker_open_stream(w, p["task_id"])
         elif kind == "close_stream":

