@@ -45,6 +45,88 @@ def _cmd_timeline(args):
     ray_tpu.shutdown()
 
 
+def _connect(address):
+    """Attach to a running session, or start a local one as a fallback.
+    Returns "attached" or "ephemeral" (CLI-scoped local session)."""
+    import os
+
+    import ray_tpu
+    if address or os.environ.get("RAY_TPU_ADDRESS"):
+        ray_tpu.init(address=address or "auto", ignore_reinit_error=True)
+        return "attached"
+    ray_tpu.init(ignore_reinit_error=True)
+    return "ephemeral"
+
+
+def _job_client(args):
+    from ray_tpu.job_submission import JobSubmissionClient
+    address = getattr(args, "address", None)
+    if address and address.startswith("http"):
+        return JobSubmissionClient(address), "attached"
+    mode = _connect(address)
+    return JobSubmissionClient(), mode
+
+
+def _cmd_job(args):
+    client, session_mode = _job_client(args)
+    if args.job_cmd == "submit" and args.no_wait and session_mode == "ephemeral":
+        # the session lives in THIS process; returning would tear it down and
+        # kill the job moments after submit — wait instead of losing it
+        print("warning: no running session (RAY_TPU_ADDRESS unset); the job "
+              "runs under this CLI's ephemeral session, so --no-wait is "
+              "ignored and logs will stream until it finishes", file=sys.stderr)
+        args.no_wait = False
+    if args.job_cmd == "submit":
+        import shlex
+        rte = {}
+        if args.working_dir:
+            rte["working_dir"] = args.working_dir
+        if args.env:
+            rte["env_vars"] = dict(kv.split("=", 1) for kv in args.env)
+        words = args.entrypoint
+        if words and words[0] == "--":
+            words = words[1:]
+        jid = client.submit_job(entrypoint=shlex.join(words),
+                                submission_id=args.submission_id,
+                                runtime_env=rte or None)
+        print(f"submitted: {jid}")
+        if not args.no_wait:
+            for chunk in client.tail_job_logs(jid):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+            status = client.get_job_status(jid)
+            print(f"job {jid} finished: {status.value}")
+            sys.exit(0 if status.value == "SUCCEEDED" else 1)
+    elif args.job_cmd == "status":
+        print(client.get_job_status(args.id).value)
+    elif args.job_cmd == "logs":
+        if args.follow:
+            for chunk in client.tail_job_logs(args.id):
+                sys.stdout.write(chunk)
+                sys.stdout.flush()
+        else:
+            sys.stdout.write(client.get_job_logs(args.id))
+    elif args.job_cmd == "stop":
+        print("stopped" if client.stop_job(args.id) else "already finished")
+    elif args.job_cmd == "list":
+        for info in client.list_jobs():
+            print(f"{info.submission_id}  {info.status:<10} {info.entrypoint}")
+
+
+def _cmd_dashboard(args):
+    import time
+
+    _connect(args.address)
+    from ray_tpu.dashboard import start_dashboard
+    _actor, port = start_dashboard(args.host, args.port)
+    print(f"dashboard: http://{args.host}:{port}  (ctrl-c to exit)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
@@ -52,9 +134,35 @@ def main(argv=None):
     sub.add_parser("topology", help="TPU slice topology")
     tl = sub.add_parser("timeline", help="export chrome trace")
     tl.add_argument("--output", default="timeline.json")
+
+    job = sub.add_parser("job", help="submit / inspect / stop jobs")
+    jsub = job.add_subparsers(dest="job_cmd", required=True)
+    js = jsub.add_parser("submit", help="run an entrypoint as a job")
+    js.add_argument("--address", default=None)
+    js.add_argument("--submission-id", default=None)
+    js.add_argument("--working-dir", default=None)
+    js.add_argument("--env", action="append", metavar="K=V")
+    js.add_argument("--no-wait", action="store_true",
+                    help="return after submit instead of streaming logs")
+    js.add_argument("entrypoint", nargs=argparse.REMAINDER)
+    for name in ("status", "logs", "stop"):
+        p = jsub.add_parser(name)
+        p.add_argument("id")
+        p.add_argument("--address", default=None)
+        if name == "logs":
+            p.add_argument("--follow", action="store_true")
+    jl = jsub.add_parser("list")
+    jl.add_argument("--address", default=None)
+
+    dash = sub.add_parser("dashboard", help="serve the HTTP state/job API")
+    dash.add_argument("--host", default="127.0.0.1")
+    dash.add_argument("--port", type=int, default=8265)
+    dash.add_argument("--address", default=None)
+
     args = parser.parse_args(argv)
     {"status": _cmd_status, "topology": _cmd_topology,
-     "timeline": _cmd_timeline}[args.cmd](args)
+     "timeline": _cmd_timeline, "job": _cmd_job,
+     "dashboard": _cmd_dashboard}[args.cmd](args)
 
 
 if __name__ == "__main__":

@@ -199,12 +199,22 @@ class WorkerClient(BaseClient):
     loop, "resp" messages resolve pending request futures.
     """
 
-    def __init__(self, socket_path: str, worker_id: str):
-        super().__init__()
+    def __init__(self, socket_path: str, worker_id: str, driver: bool = False):
+        """driver=True attaches this process to an EXISTING session
+        (ray.init(address=...) parity): same RPC surface, never receives
+        task executions, and learns the session's shm arena via handshake."""
+        import os as _os
+        if driver:
+            # BaseClient.__init__ would build the store before we know the
+            # arena; defer it until after the hello handshake below
+            self.store = None
+            self.job_id = None
+        else:
+            super().__init__()
         self.worker_id = worker_id
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(socket_path)
-        self.is_driver = False
+        self.is_driver = driver
         self._lock = threading.Lock()
         self._reqs = {}
         self._req_counter = 0
@@ -212,9 +222,17 @@ class WorkerClient(BaseClient):
         self.task_available = threading.Condition()
         self._current = threading.local()  # per-exec-thread task id
         self.task_threads = {}  # task_id -> thread ident (for targeted cancel)
-        protocol.send_msg(self.sock, "register", worker_id=worker_id, pid=__import__("os").getpid())
+        protocol.send_msg(self.sock, "register", worker_id=worker_id,
+                          pid=_os.getpid(), driver=driver)
         self._recv_thread = threading.Thread(target=self._recv_loop, daemon=True)
         self._recv_thread.start()
+        if driver:
+            hello = self._rpc("hello", timeout=10)
+            if hello.get("arena"):
+                _os.environ["RAY_TPU_ARENA"] = hello["arena"]
+                _os.environ["RAY_TPU_STORE_BYTES"] = str(hello["store_bytes"])
+            self.store = StoreClient()
+            self.job_id = hello["job_id"]
 
     @property
     def current_task_id(self):
@@ -384,16 +402,20 @@ class WorkerClient(BaseClient):
         return self._rpc("obj_sizes", oids=oids)["sizes"]
 
     def state(self, kind):
-        raise NotImplementedError("state API is driver-only in round 1")
+        return self._rpc("state", which=kind)["rows"]
+
+    def timeline(self):
+        return self._rpc("timeline")["events"]
 
     def next_stream_item(self, task_id, index, timeout=None):
         return self._rpc("next_stream", task_id=task_id, index=index, timeout=timeout)["item"]
 
     def create_placement_group(self, bundles, strategy, name=""):
-        raise NotImplementedError("placement groups are driver-only in round 1")
+        return self._rpc("create_pg", bundles=bundles, strategy=strategy,
+                         name=name)["pg_id"]
 
     def remove_placement_group(self, pg_id):
-        raise NotImplementedError
+        self._rpc("remove_pg", pg_id=pg_id)
 
     def as_future(self, ref):
         fut = concurrent.futures.Future()

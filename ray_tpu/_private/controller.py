@@ -233,7 +233,9 @@ class Controller:
         w = self.spawning.pop(wid, None) or WorkerConn(worker_id=wid)
         w.writer = writer
         w.pid = msg[1].get("pid", 0)
-        w.state = "idle"
+        # an attached driver (ray_tpu.init(address=...), e.g. a submitted job)
+        # shares the API surface over this socket but never executes tasks
+        w.state = "driver" if msg[1].get("driver") else "idle"
         self.workers[wid] = w
         if w.actor_id:
             # dedicated actor worker: dispatch the pending creation task
@@ -300,6 +302,29 @@ class Controller:
             self._reply(w, p["req_id"], sizes=[
                 self.objects[o].size if o in self.objects else 0
                 for o in p["oids"]])
+        elif kind == "hello":
+            # attach handshake: the session's shm arena + job identity so a
+            # process with no inherited env can join (ref: ray.init(address=))
+            self._reply(w, p["req_id"],
+                        arena=os.environ.get("RAY_TPU_ARENA"),
+                        store_bytes=self.store_capacity,
+                        job_id=self.job_id, socket_path=self.socket_path)
+        elif kind == "state":
+            try:
+                self._reply(w, p["req_id"], rows=self.state_snapshot(p["which"]))
+            except ValueError as e:
+                self._reply(w, p["req_id"], error=e)
+        elif kind == "timeline":
+            self._reply(w, p["req_id"], events=list(self.timeline_events))
+        elif kind == "create_pg":
+            try:
+                self._reply(w, p["req_id"], pg_id=self.create_placement_group(
+                    p["bundles"], p["strategy"], p.get("name", "")))
+            except ValueError as e:
+                self._reply(w, p["req_id"], error=e)
+        elif kind == "remove_pg":
+            self.remove_placement_group(p["pg_id"])
+            self._reply(w, p["req_id"], ok=True)
         elif kind == "open_stream":
             self._worker_open_stream(w, p["task_id"])
         elif kind == "close_stream":
@@ -549,7 +574,7 @@ class Controller:
         spawning = sum(1 for w in self.spawning.values()
                        if w.actor_id is None and not w.tpu_capable)
         n_alive = sum(1 for w in list(self.workers.values()) + list(self.spawning.values())
-                      if w.actor_id is None and w.state != "dead")
+                      if w.actor_id is None and w.state not in ("dead", "driver"))
         n_blocked = sum(1 for w in self.workers.values()
                         if w.actor_id is None and w.blocked_tasks)
         headroom = self.max_workers - (n_alive - n_blocked)

@@ -14,7 +14,7 @@ import tempfile
 import threading
 
 from ._private import ids, state
-from ._private.client import DriverClient
+from ._private.client import DriverClient, WorkerClient
 from ._private.controller import Controller, DEFAULT_CAPACITY
 from ._private.object_ref import ObjectRef, ObjectRefGenerator
 from .actor import ActorClass, ActorHandle
@@ -52,11 +52,15 @@ def is_initialized() -> bool:
 
 def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
          object_store_memory=None, ignore_reinit_error=False, max_workers=None,
-         **_compat):
-    """Start the ray_tpu runtime in this process (the driver).
+         address=None, **_compat):
+    """Start the ray_tpu runtime in this process (the driver), or — with
+    `address` — ATTACH to a session another process started (reference:
+    ray.init(address="auto") / address=<endpoint>). `address` is the
+    controller's unix socket path, or "auto" to read RAY_TPU_ADDRESS (set by
+    the owning session and inherited by its workers and submitted jobs).
 
-    Unrecognized reference kwargs (address, dashboard_*, logging_*) are
-    accepted and ignored for drop-in compatibility.
+    Unrecognized reference kwargs (dashboard_*, logging_*) are accepted and
+    ignored for drop-in compatibility.
     """
     global _runtime
     with _lock:
@@ -64,6 +68,17 @@ def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
             if ignore_reinit_error:
                 return
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True.")
+        if address is not None:
+            sock = os.environ.get("RAY_TPU_ADDRESS") if address == "auto" else address
+            if not sock or not os.path.exists(sock):
+                raise ConnectionError(
+                    f"no ray_tpu session at address {address!r} (socket {sock!r})")
+            client = WorkerClient(sock, ids.worker_id(), driver=True)
+            client.namespace = namespace or "default"
+            state.set_global_client(client)
+            _runtime = _Runtime(None, None, None, client, namespace or "default")
+            atexit.register(shutdown)
+            return
         total = dict(resources or {})
         total["CPU"] = float(num_cpus if num_cpus is not None else max(os.cpu_count(), 4))
         ntpu = num_tpus if num_tpus is not None else _detect_tpus()
@@ -76,6 +91,9 @@ def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
         capacity = object_store_memory or DEFAULT_CAPACITY
         os.environ["RAY_TPU_ARENA"] = f"rtpu-arena-{os.getpid()}-{ids.new_id('a')[-8:]}"
         os.environ["RAY_TPU_STORE_BYTES"] = str(capacity)
+        # discoverable by children (workers, submitted job drivers) for
+        # init(address="auto") attachment
+        os.environ["RAY_TPU_ADDRESS"] = sock
         controller = Controller(
             sock, total, job_id=ids.job_id(),
             max_workers=max_workers,
@@ -107,6 +125,15 @@ def shutdown():
         if _runtime is None:
             return
         rt, _runtime = _runtime, None
+        if rt.controller is None:
+            # attached driver: just drop the connection; the owning session
+            # reconciles our handle refs via the worker-death path
+            try:
+                rt.client.close()
+            except Exception:  # noqa: BLE001
+                pass
+            state.set_global_client(None)
+            return
         try:
             fut = asyncio.run_coroutine_threadsafe(rt.controller.shutdown(), rt.loop)
             fut.result(10)
@@ -204,7 +231,7 @@ def get_actor(name, namespace=None) -> ActorHandle:
 
 def _actor_method_meta(actor_id):
     client = state.global_client()
-    if getattr(client, "is_driver", False):
+    if getattr(client, "is_driver", False) and hasattr(client, "controller"):
         actor = client.controller.actors.get(actor_id)
         if actor is not None and actor.creation_spec is not None:
             import cloudpickle

@@ -1,0 +1,176 @@
+"""Dashboard HTTP surface (reference: python/ray/dashboard/dashboard.py +
+dashboard/modules/job/job_head.py REST routes).
+
+One zero-CPU actor serving JSON over the same hand-rolled asyncio HTTP/1.1
+plumbing as the serve proxy (serve/proxy.py read_http_request — this image
+has no aiohttp/starlette). The CLI (`ray_tpu dashboard`, `ray_tpu job --address
+http://...`) and any browser/curl share this one surface:
+
+  GET  /api/version            build + session info
+  GET  /api/cluster_status     resources + store usage
+  GET  /api/nodes|actors|tasks|objects|workers    state-API snapshots
+  GET  /api/jobs/              list jobs
+  POST /api/jobs/              {entrypoint, submission_id?, runtime_env?, metadata?}
+  GET  /api/jobs/{id}          job info
+  GET  /api/jobs/{id}/logs     {"logs": ..., "next_offset": N, "terminal": bool}
+  POST /api/jobs/{id}/stop     {"stopped": bool}
+"""
+
+import asyncio
+import json
+import traceback
+from typing import Optional, Tuple
+
+from ray_tpu.serve.proxy import (Request, Response, _BadRequest,
+                                 _ChunkedBodyUnsupported, _coerce_response,
+                                 read_http_request, write_http_response)
+
+DASHBOARD_ACTOR_NAME = "_rtpu_dashboard"
+DASHBOARD_NAMESPACE = "_system"
+
+
+class DashboardActor:
+    """max_concurrency>1 async actor: the asyncio server shares the loop."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8265):
+        self._host = host
+        self._port = port
+        self._server = None
+        self._mgr = None
+
+    async def ready(self) -> int:
+        if self._server is None:
+            self._server = await asyncio.start_server(
+                self._serve_client, self._host, self._port)
+            self._port = self._server.sockets[0].getsockname()[1]
+        return self._port
+
+    def _job_manager(self):
+        if self._mgr is None:
+            from ray_tpu.job_submission import _get_or_create_manager
+            self._mgr = _get_or_create_manager()
+        return self._mgr
+
+    async def _serve_client(self, reader, writer):
+        try:
+            while True:
+                try:
+                    req = await read_http_request(reader)
+                except _ChunkedBodyUnsupported:
+                    await write_http_response(writer, Response(
+                        b"chunked request bodies are not supported", 411,
+                        media_type="text/plain"))
+                    break
+                except _BadRequest as e:
+                    await write_http_response(writer, Response(
+                        str(e).encode(), 400, media_type="text/plain"))
+                    break
+                if req is None:
+                    break
+                try:
+                    resp = await self._route(req)
+                except ValueError as e:
+                    resp = Response(json.dumps({"error": str(e)}).encode(), 404)
+                except Exception:  # noqa: BLE001 - handler error → 500
+                    resp = Response(traceback.format_exc().encode(), 500,
+                                    media_type="text/plain")
+                await write_http_response(writer, resp)
+                if req.headers.get("connection", "").lower() == "close":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except Exception:  # noqa: BLE001
+                pass
+
+    async def _route(self, req: Request) -> Response:
+        from ray_tpu._private import state as _state
+        path = req.path.rstrip("/") or "/"
+        client = _state.global_client()
+
+        if path == "/api/version":
+            return _coerce_response({
+                "ray_tpu_version": "0.3", "session": client.job_id})
+        if path == "/api/cluster_status":
+            total, avail = client.resources()
+            nodes = client.state("nodes")
+            return _coerce_response({
+                "total_resources": total, "available_resources": avail,
+                "nodes": nodes})
+        if path in ("/api/nodes", "/api/actors", "/api/tasks", "/api/objects",
+                    "/api/workers"):
+            return _coerce_response(client.state(path.rsplit("/", 1)[-1]))
+
+        if path == "/api/jobs":
+            loop = asyncio.get_running_loop()
+            if req.method == "POST":
+                spec = req.json() or {}
+                if "entrypoint" not in spec:
+                    return Response(b'{"error": "entrypoint required"}', 400)
+                rte = spec.get("runtime_env") or {}
+                jid = await loop.run_in_executor(None, self._submit, spec, rte)
+                return _coerce_response({"submission_id": jid})
+            rows = await loop.run_in_executor(None, self._mgr_call, "list")
+            return _coerce_response(rows)
+
+        if path.startswith("/api/jobs/"):
+            rest = path[len("/api/jobs/"):]
+            loop = asyncio.get_running_loop()
+            if rest.endswith("/logs"):
+                jid = rest[:-len("/logs")]
+                offset = int(req.query_params.get("offset", "0"))
+                chunk, nxt, terminal = await loop.run_in_executor(
+                    None, self._mgr_call, "logs", jid, offset)
+                return _coerce_response({
+                    "logs": chunk.decode("utf-8", "replace"),
+                    "next_offset": nxt, "terminal": terminal})
+            if rest.endswith("/stop") and req.method == "POST":
+                jid = rest[:-len("/stop")]
+                stopped = await loop.run_in_executor(
+                    None, self._mgr_call, "stop", jid)
+                return _coerce_response({"stopped": stopped})
+            info = await loop.run_in_executor(
+                None, self._mgr_call, "get_info", rest)
+            return _coerce_response(info)
+
+        return Response(json.dumps({"error": f"no route {path}"}).encode(), 404)
+
+    # blocking helpers run on the default executor so replica IO can't stall
+    # other dashboard connections
+    def _submit(self, spec, rte):
+        import ray_tpu
+        return ray_tpu.get(self._job_manager().submit.remote(
+            spec["entrypoint"], spec.get("submission_id"),
+            rte.get("env_vars"), rte.get("working_dir"),
+            spec.get("metadata")), timeout=60)
+
+    def _mgr_call(self, method, *args):
+        import ray_tpu
+        return ray_tpu.get(
+            getattr(self._job_manager(), method).remote(*args), timeout=60)
+
+    def stats(self):
+        return {"host": self._host, "port": self._port}
+
+
+def start_dashboard(host: str = "127.0.0.1", port: int = 8265
+                    ) -> Tuple[object, int]:
+    """Get-or-start the dashboard actor; returns (handle, bound_port)."""
+    import ray_tpu
+    try:
+        actor = ray_tpu.get_actor(DASHBOARD_ACTOR_NAME,
+                                  namespace=DASHBOARD_NAMESPACE)
+    except ValueError:
+        cls = ray_tpu.remote(num_cpus=0, max_concurrency=16)(DashboardActor)
+        try:
+            actor = cls.options(name=DASHBOARD_ACTOR_NAME,
+                                namespace=DASHBOARD_NAMESPACE,
+                                lifetime="detached").remote(host, port)
+        except ValueError:
+            actor = ray_tpu.get_actor(DASHBOARD_ACTOR_NAME,
+                                      namespace=DASHBOARD_NAMESPACE)
+    bound = ray_tpu.get(actor.ready.remote(), timeout=60)
+    return actor, bound

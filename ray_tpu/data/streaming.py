@@ -136,6 +136,10 @@ def _reduce_task(refs, p, _fn):
     return _fn(parts, p)
 
 
+def _single_part_map(ref, _map_fn, idx):
+    return _map_fn(ref, 1, idx)[0]
+
+
 class StreamingExecutor:
     """Drives source thunks through map / shuffle operator states."""
 
@@ -216,12 +220,17 @@ class StreamingExecutor:
                     idx, ref = st.pop_input()
                     if st.t0 is None:
                         st.t0 = time.perf_counter()
-                    parts = self._remote(
-                        f"{i}:{st.name}.map", op.map_fn,
-                        num_returns=op.num_partitions,
-                    ).remote(ref, op.num_partitions, idx)
                     if op.num_partitions == 1:
-                        parts = [parts]
+                        # num_returns=1 would store the whole 1-tuple as the
+                        # result; unwrap in-task so reduce gets a block
+                        parts = [self._remote(
+                            f"{i}:{st.name}.map", _single_part_map,
+                        ).remote(ref, op.map_fn, idx)]
+                    else:
+                        parts = self._remote(
+                            f"{i}:{st.name}.map", op.map_fn,
+                            num_returns=op.num_partitions,
+                        ).remote(ref, op.num_partitions, idx)
                     st.map_inflight[parts[0]] = (idx, parts)
                 if (st.input_done and not st.inq and not st.map_inflight
                         and not st.reduce_started):

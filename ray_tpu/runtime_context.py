@@ -46,8 +46,14 @@ class RuntimeContext:
 def get_runtime_context() -> RuntimeContext:
     client = state.global_client()
     if getattr(client, "is_driver", False):
-        return RuntimeContext(job_id=client.job_id,
-                              node_id=client.controller.node_id)
+        # attached drivers (init(address=...)) have no in-process controller;
+        # their node identity comes from the session's state API
+        if hasattr(client, "controller"):
+            node_id = client.controller.node_id
+        else:
+            nodes = client.state("nodes")
+            node_id = nodes[0]["node_id"] if nodes else None
+        return RuntimeContext(job_id=client.job_id, node_id=node_id)
     ws = state.worker_state()
     spec = getattr(ws.current, "spec", None) if ws else None
     env_tpus = os.environ.get("RAY_TPU_IDS", "")

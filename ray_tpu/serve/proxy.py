@@ -78,6 +78,57 @@ class _BadRequest(Exception):
     pass
 
 
+async def read_http_request(reader) -> Optional[Request]:
+    """Parse one HTTP/1.1 request (request line, headers, Content-Length
+    body). Shared by the serve proxy and the dashboard server."""
+    line = await reader.readline()
+    if not line or line in (b"\r\n", b"\n"):
+        return None
+    try:
+        method, target, _version = line.decode("latin1").split(" ", 2)
+    except ValueError:
+        return None
+    headers = {}
+    while True:
+        hline = await reader.readline()
+        if hline in (b"\r\n", b"\n", b""):
+            break
+        if b":" in hline:
+            k, v = hline.decode("latin1").split(":", 1)
+            headers[k.strip().lower()] = v.strip()
+    if "chunked" in headers.get("transfer-encoding", "").lower():
+        # not supported; reading it as a request line would desync the
+        # connection — surface 411 and close (handled by caller)
+        raise _ChunkedBodyUnsupported()
+    try:
+        length = int(headers.get("content-length", 0) or 0)
+        if length < 0:
+            raise ValueError(length)
+    except ValueError:
+        raise _BadRequest("invalid Content-Length") from None
+    body = await reader.readexactly(length) if length else b""
+    parts = urlsplit(target)
+    return Request(method.upper(), unquote(parts.path), parts.query,
+                   headers, body)
+
+
+def http_head(status: int, headers: Dict[str, str]) -> bytes:
+    text = _STATUS_TEXT.get(status, "Unknown")
+    lines = [f"HTTP/1.1 {status} {text}"]
+    lines += [f"{k}: {v}" for k, v in headers.items()]
+    return ("\r\n".join(lines) + "\r\n\r\n").encode("latin1")
+
+
+async def write_http_response(writer, resp: Response) -> None:
+    body = resp.content if isinstance(resp.content, bytes) else \
+        str(resp.content).encode()
+    headers = {"Content-Length": str(len(body)),
+               "Content-Type": resp.media_type or "application/json",
+               **resp.headers}
+    writer.write(http_head(resp.status_code, headers) + body)
+    await writer.drain()
+
+
 def _coerce_response(out) -> Response:
     if isinstance(out, Response):
         return out
@@ -183,51 +234,14 @@ class ProxyActor:
                 pass
 
     async def _read_request(self, reader) -> Optional[Request]:
-        line = await reader.readline()
-        if not line or line in (b"\r\n", b"\n"):
-            return None
-        try:
-            method, target, _version = line.decode("latin1").split(" ", 2)
-        except ValueError:
-            return None
-        headers = {}
-        while True:
-            hline = await reader.readline()
-            if hline in (b"\r\n", b"\n", b""):
-                break
-            if b":" in hline:
-                k, v = hline.decode("latin1").split(":", 1)
-                headers[k.strip().lower()] = v.strip()
-        if "chunked" in headers.get("transfer-encoding", "").lower():
-            # not supported; reading it as a request line would desync the
-            # connection — surface 411 and close (handled by caller)
-            raise _ChunkedBodyUnsupported()
-        try:
-            length = int(headers.get("content-length", 0) or 0)
-            if length < 0:
-                raise ValueError(length)
-        except ValueError:
-            raise _BadRequest("invalid Content-Length") from None
-        body = await reader.readexactly(length) if length else b""
-        parts = urlsplit(target)
-        return Request(method.upper(), unquote(parts.path), parts.query,
-                       headers, body)
+        return await read_http_request(reader)
 
     @staticmethod
     def _head(status: int, headers: Dict[str, str]) -> bytes:
-        text = _STATUS_TEXT.get(status, "Unknown")
-        lines = [f"HTTP/1.1 {status} {text}"]
-        lines += [f"{k}: {v}" for k, v in headers.items()]
-        return ("\r\n".join(lines) + "\r\n\r\n").encode("latin1")
+        return http_head(status, headers)
 
     async def _write_plain(self, writer, resp: Response) -> None:
-        body = resp.content if isinstance(resp.content, bytes) else \
-            str(resp.content).encode()
-        headers = {"Content-Length": str(len(body)),
-                   "Content-Type": resp.media_type or "application/json",
-                   **resp.headers}
-        writer.write(self._head(resp.status_code, headers) + body)
-        await writer.drain()
+        await write_http_response(writer, resp)
 
     async def _serve_one(self, reader, writer) -> bool:
         try:
