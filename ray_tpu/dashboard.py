@@ -107,7 +107,12 @@ class DashboardActor:
         if path == "/api/jobs":
             loop = asyncio.get_running_loop()
             if req.method == "POST":
-                spec = req.json() or {}
+                try:
+                    spec = req.json() or {}
+                except json.JSONDecodeError as e:
+                    return Response(
+                        json.dumps({"error": f"invalid JSON body: {e}"}).encode(),
+                        400)
                 if "entrypoint" not in spec:
                     return Response(b'{"error": "entrypoint required"}', 400)
                 rte = spec.get("runtime_env") or {}

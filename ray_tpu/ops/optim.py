@@ -1,0 +1,89 @@
+"""Optimizer + LR-schedule factory shared by train and rllib learners.
+
+Reference: rllib/core/learner/learner.py lr_schedule plumbing (piecewise
+[[timestep, lr], ...]) and the torch optimizer wiring. TPU-side this is pure
+optax: one `optax.chain` (clip → transform → schedule) whose schedule is a
+jit-friendly step function, so the whole update including the lr lookup
+compiles into the learner's one fused step.
+
+`lr_schedule` accepts:
+- None                       → constant `lr`
+- {"type": "cosine", "warmup_steps": W, "decay_steps": N, "final_lr_scale": a}
+- {"type": "linear", "warmup_steps": W, "decay_steps": N, "final_lr_scale": a}
+- {"type": "constant", "warmup_steps": W}
+- [[step, lr], ...]          → piecewise linear interpolation (reference style)
+"""
+
+from typing import Optional, Sequence, Union
+
+ScheduleSpec = Union[None, dict, Sequence]
+
+
+def make_lr_schedule(lr: float, lr_schedule: ScheduleSpec = None):
+    """Returns an optax schedule fn: step -> learning rate."""
+    import jax.numpy as jnp
+    import optax
+
+    if lr_schedule is None:
+        return optax.constant_schedule(lr)
+
+    if isinstance(lr_schedule, dict):
+        kind = lr_schedule.get("type", "cosine")
+        warmup = int(lr_schedule.get("warmup_steps", 0))
+        if kind == "constant":
+            if warmup:
+                return optax.join_schedules(
+                    [optax.linear_schedule(0.0, lr, warmup),
+                     optax.constant_schedule(lr)], [warmup])
+            return optax.constant_schedule(lr)
+        decay = int(lr_schedule["decay_steps"])
+        end = lr * float(lr_schedule.get("final_lr_scale", 0.0))
+        if kind == "cosine":
+            return optax.warmup_cosine_decay_schedule(
+                init_value=0.0 if warmup else lr, peak_value=lr,
+                warmup_steps=warmup, decay_steps=decay, end_value=end)
+        if kind == "linear":
+            pieces = []
+            bounds = []
+            if warmup:
+                pieces.append(optax.linear_schedule(0.0, lr, warmup))
+                bounds.append(warmup)
+            pieces.append(optax.linear_schedule(lr, end, max(decay - warmup, 1)))
+            pieces.append(optax.constant_schedule(end))
+            bounds.append(decay)
+            return optax.join_schedules(pieces, bounds)
+        raise ValueError(f"unknown lr_schedule type {kind!r}")
+
+    # reference-style piecewise [[step, value], ...] with linear interpolation
+    points = sorted((int(s), float(v)) for s, v in lr_schedule)
+    if not points:
+        return optax.constant_schedule(lr)
+    xs = jnp.asarray([p[0] for p in points], jnp.float32)
+    ys = jnp.asarray([p[1] for p in points], jnp.float32)
+
+    def schedule(step):
+        return jnp.interp(jnp.asarray(step, jnp.float32), xs, ys)
+
+    return schedule
+
+
+def make_optimizer(*, lr: float = 3e-4, lr_schedule: ScheduleSpec = None,
+                   optimizer: str = "adam", grad_clip: Optional[float] = None,
+                   weight_decay: float = 0.0, momentum: float = 0.9):
+    """Returns (optax transform, schedule_fn). The schedule_fn is exposed so
+    callers can log the current lr (metrics["cur_lr"])."""
+    import optax
+
+    schedule = make_lr_schedule(lr, lr_schedule)
+    tx = []
+    if grad_clip:
+        tx.append(optax.clip_by_global_norm(grad_clip))
+    if optimizer == "adam":
+        tx.append(optax.adam(schedule))
+    elif optimizer == "adamw":
+        tx.append(optax.adamw(schedule, weight_decay=weight_decay))
+    elif optimizer == "sgd":
+        tx.append(optax.sgd(schedule, momentum=momentum))
+    else:
+        raise ValueError(f"unknown optimizer {optimizer!r}")
+    return optax.chain(*tx), schedule

@@ -6,9 +6,9 @@ update as one jitted program on the TPU.
 """
 
 from .algorithm import Algorithm, AlgorithmConfig
-from .algorithms import (APPO, APPOConfig, BC, BCConfig, DQN, DQNConfig,
-                         IMPALA, IMPALAConfig, MARWIL, MARWILConfig, PPO,
-                         PPOConfig, SAC, SACConfig)
+from .algorithms import (APPO, APPOConfig, BC, BCConfig, CQL, CQLConfig, DQN,
+                         DQNConfig, IMPALA, IMPALAConfig, MARWIL,
+                         MARWILConfig, PPO, PPOConfig, SAC, SACConfig)
 from .buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .env_runner import EnvRunner
 from .learner import JaxLearner, LearnerGroup, make_learner_group

@@ -1,5 +1,6 @@
 from .appo import APPO, APPOConfig
 from .bc import BC, BCConfig, MARWIL, MARWILConfig
+from .cql import CQL, CQLConfig
 from .dqn import DQN, DQNConfig
 from .impala import IMPALA, IMPALAConfig
 from .ppo import PPO, PPOConfig

@@ -1,0 +1,249 @@
+"""CQL — Conservative Q-Learning (reference: rllib/algorithms/cql/cql.py,
+which layers a conservative penalty over SAC's twin critics and trains from
+offline data only).
+
+Re-uses this framework's SAC building blocks (SACModule actor/critics,
+squashed-gaussian policy, auto-tuned temperature, polyak targets) with two
+changes, both inside the ONE jitted update:
+- critic loss gains the CQL(H) regularizer
+  `alpha_cql * (logsumexp_a Q(s,a) - Q(s, a_data))`, where the logsumexp is
+  estimated with importance-weighted uniform-random actions plus policy
+  samples at s and s' (the standard CQL sampling scheme).
+- no environment interaction: batches come from an offline SampleBatch /
+  ray_tpu.data Dataset (rllib/offline.py reader).
+"""
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import sample_batch as SB
+from ..algorithm import Algorithm
+from ..distributions import SquashedGaussian
+from ..offline import as_sample_batch
+from ..rl_module import ModuleSpec
+from .sac import SACConfig, SACModule
+
+
+class CQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = CQL
+        self.offline_data = None
+        self.cql_alpha = 1.0          # min_q_weight
+        self.num_cql_actions = 4      # sampled actions per source (rand/pi/pi')
+        self.bc_iters = 0             # actor log-prob warmstart updates
+        self.train_intensity = 8      # SGD steps per train() call
+        self.action_low = None        # None → inferred from the dataset
+        self.action_high = None
+
+    def offline_data_source(self, data):
+        self.offline_data = data
+        return self
+
+
+class CQL(Algorithm):
+    # SAC-style weight dict ({actor, q1, ...}) can't ride a generic EnvRunner;
+    # evaluation uses this class's own inline loop below
+    _supports_eval_actors = False
+
+    def setup(self, config: CQLConfig):
+        if config.offline_data is None:
+            raise ValueError("CQL needs config.offline_data")
+        batch = as_sample_batch(config.offline_data)
+        self._data = {k: np.asarray(batch[k]) for k in
+                      (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS,
+                       SB.TERMINATEDS)}
+        self._n = len(self._data[SB.OBS])
+        acts = self._data[SB.ACTIONS]
+        if acts.ndim == 1:
+            acts = acts[:, None]
+            self._data[SB.ACTIONS] = acts
+        obs_shape = self._data[SB.OBS].shape[1:]
+        action_dim = acts.shape[-1]
+        low = (config.action_low if config.action_low is not None
+               else float(acts.min()))
+        high = (config.action_high if config.action_high is not None
+                else float(acts.max()))
+        spec = ModuleSpec(obs_shape, "continuous", action_dim,
+                          tuple(config.model.get("hiddens", (256, 256))))
+        self.module = SACModule(spec, low, high)
+        key = jax.random.PRNGKey(config.seed)
+        self.weights = self.module.init(key)
+        from ray_tpu.ops.optim import make_optimizer
+        self.opt, self._lr_schedule = make_optimizer(
+            lr=config.lr, lr_schedule=getattr(config, "lr_schedule", None),
+            optimizer=getattr(config, "optimizer", "adam"),
+            grad_clip=getattr(config, "grad_clip", None))
+        self.opt_state = {
+            "actor": self.opt.init(self.weights["actor"]),
+            "q1": self.opt.init(self.weights["q1"]),
+            "q2": self.opt.init(self.weights["q2"]),
+            "alpha": self.opt.init(self.weights["log_alpha"])}
+        self.target_entropy = (config.target_entropy
+                               if config.target_entropy is not None
+                               else -float(action_dim))
+        self._rng = np.random.default_rng(config.seed)
+        self._updates = 0
+        self._build_update()
+
+    # ------------------------------------------------------------ jit update
+    def _build_update(self):
+        cfg = self.config
+        mod = self.module
+        gamma, tau = cfg.gamma, cfg.tau
+        target_entropy = self.target_entropy
+        n_act = cfg.num_cql_actions
+        cql_alpha = cfg.cql_alpha
+        low, high = mod.low, mod.high
+        d_act = mod.spec.action_dim
+        # log-density of the uniform proposal, for importance weighting
+        log_u = -d_act * float(np.log(max(high - low, 1e-8)))
+
+        def policy_samples(w, obs_rep, key):
+            mean, log_std = mod.actor.apply(w["actor"], obs_rep)
+            dist = SquashedGaussian(mean, log_std, low, high)
+            a, logp = dist.sample_and_log_prob(key)
+            return jax.lax.stop_gradient(a), jax.lax.stop_gradient(logp)
+
+        def update(w, opt_state, batch, key, bc_phase):
+            import optax
+            obs, act = batch[SB.OBS], batch[SB.ACTIONS]
+            nxt, rew = batch[SB.NEXT_OBS], batch[SB.REWARDS]
+            done = batch[SB.TERMINATEDS]
+            b = obs.shape[0]
+            alpha = jnp.exp(w["log_alpha"])
+            k_t, k_pi, k_rand, k_spi, k_spin = jax.random.split(key, 5)
+
+            # -- SAC bellman target (twin targets, entropy-regularized)
+            dist_n, _ = mod._dist(w, nxt)
+            a_n, logp_n = dist_n.sample_and_log_prob(k_t)
+            q1_n = mod.critic.apply(w["q1_target"], nxt, a_n)
+            q2_n = mod.critic.apply(w["q2_target"], nxt, a_n)
+            target = rew + gamma * (1 - done) * (
+                jnp.minimum(q1_n, q2_n) - alpha * logp_n)
+            target = jax.lax.stop_gradient(target)
+
+            # -- conservative term inputs (shared across both critics)
+            rep = lambda x: jnp.repeat(x, n_act, axis=0)  # [N*B, ...]
+            obs_rep, nxt_rep = rep(obs), rep(nxt)
+            a_rand = jax.random.uniform(k_rand, (n_act * b, d_act),
+                                        minval=low, maxval=high)
+            a_pi, logp_pi = policy_samples(w, obs_rep, k_spi)
+            a_pin, logp_pin = policy_samples(w, nxt_rep, k_spin)
+
+            def q_loss(qp):
+                q_data = mod.critic.apply(qp, obs, act)
+                bellman = jnp.mean(jnp.square(q_data - target))
+                # jnp.repeat lays rows out state-major (s0,s0,..,s1,s1,..), so
+                # (b, n_act) keeps each row's samples with THEIR state; the
+                # logsumexp runs over the sampled-action axis
+                shape = (b, n_act)
+                q_rand = mod.critic.apply(qp, obs_rep, a_rand).reshape(shape)
+                q_pi = mod.critic.apply(qp, obs_rep, a_pi).reshape(shape)
+                q_pin = mod.critic.apply(qp, nxt_rep, a_pin).reshape(shape)
+                cat = jnp.concatenate([
+                    q_rand - log_u,
+                    q_pi - logp_pi.reshape(shape),
+                    q_pin - logp_pin.reshape(shape)], axis=1)   # [B, 3N]
+                gap = jax.scipy.special.logsumexp(cat, axis=1) - q_data
+                return bellman + cql_alpha * jnp.mean(gap), jnp.mean(gap)
+
+            (l1, gap1), g1 = jax.value_and_grad(q_loss, has_aux=True)(w["q1"])
+            (l2, _gap2), g2 = jax.value_and_grad(q_loss, has_aux=True)(w["q2"])
+            u1, opt_q1 = self.opt.update(g1, opt_state["q1"], w["q1"])
+            u2, opt_q2 = self.opt.update(g2, opt_state["q2"], w["q2"])
+            q1p = optax.apply_updates(w["q1"], u1)
+            q2p = optax.apply_updates(w["q2"], u2)
+
+            # -- actor: SAC objective, or pure BC log-prob during warmstart
+            def pi_loss(ap):
+                mean, log_std = mod.actor.apply(ap, obs)
+                dist = SquashedGaussian(mean, log_std, low, high)
+                a, logp = dist.sample_and_log_prob(k_pi)
+                q = jnp.minimum(mod.critic.apply(q1p, obs, a),
+                                mod.critic.apply(q2p, obs, a))
+                sac_obj = jnp.mean(alpha * logp - q)
+                bc_obj = -jnp.mean(dist.log_prob(act))
+                return jnp.where(bc_phase, bc_obj, sac_obj), logp
+
+            (la, logp), ga = jax.value_and_grad(
+                pi_loss, has_aux=True)(w["actor"])
+            ua, opt_a = self.opt.update(ga, opt_state["actor"], w["actor"])
+            actor_p = optax.apply_updates(w["actor"], ua)
+
+            def alpha_loss(log_alpha):
+                return -jnp.mean(jnp.exp(log_alpha) *
+                                 jax.lax.stop_gradient(logp + target_entropy))
+
+            lt, gt = jax.value_and_grad(alpha_loss)(w["log_alpha"])
+            ut, opt_t = self.opt.update(gt, opt_state["alpha"], w["log_alpha"])
+            log_alpha = optax.apply_updates(w["log_alpha"], ut)
+
+            polyak = lambda t, s: jax.tree_util.tree_map(
+                lambda a_, b_: (1 - tau) * a_ + tau * b_, t, s)
+            new_w = {"actor": actor_p, "q1": q1p, "q2": q2p,
+                     "q1_target": polyak(w["q1_target"], q1p),
+                     "q2_target": polyak(w["q2_target"], q2p),
+                     "log_alpha": log_alpha}
+            new_opt = {"actor": opt_a, "q1": opt_q1, "q2": opt_q2,
+                       "alpha": opt_t}
+            metrics = {"critic_loss": 0.5 * (l1 + l2), "actor_loss": la,
+                       "cql_penalty": gap1, "alpha": jnp.exp(log_alpha),
+                       "entropy": -jnp.mean(logp)}
+            return new_w, new_opt, metrics
+
+        self._update = jax.jit(update, donate_argnums=(0, 1),
+                               static_argnums=(4,))
+
+    # --------------------------------------------------------------- training
+    def training_step(self) -> Dict:
+        cfg = self.config
+        last = {}
+        for i in range(cfg.train_intensity):
+            idx = self._rng.integers(0, self._n, size=cfg.train_batch_size)
+            mb = {k: v[idx] for k, v in self._data.items()}
+            key = jax.random.PRNGKey(cfg.seed * 100_003 + self._updates)
+            bc_phase = self._updates < cfg.bc_iters
+            self.weights, self.opt_state, last = self._update(
+                self.weights, self.opt_state, mb, key, bc_phase)
+            self._updates += 1
+        learner = {k: float(v) for k, v in jax.device_get(last).items()}
+        learner["cur_lr"] = float(self._lr_schedule(self._updates))
+        return {"learner": learner, "num_env_steps_sampled_this_iter": 0}
+
+    # -------------------------------------------------------------- eval/util
+    def evaluate(self) -> Dict:
+        cfg = self.config
+        if cfg.env is None:
+            return {}
+        import gymnasium as gym
+        env = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env()
+        infer = jax.jit(self.module.inference_step)
+        rets, lens = [], []
+        for ep in range(cfg.evaluation_duration):
+            obs, _ = env.reset(seed=cfg.seed + 10_000 + ep)
+            ret, n, done = 0.0, 0, False
+            while not done:
+                a, _ = infer(self.weights, obs[None].astype(np.float32))
+                a = np.clip(np.asarray(a)[0], self.module.low, self.module.high)
+                obs, r, term, trunc, _ = env.step(a)
+                ret += float(r)
+                n += 1
+                done = term or trunc
+            rets.append(ret)
+            lens.append(n)
+        env.close()
+        return {"episodes_this_iter": len(rets),
+                "episode_return_mean": float(np.mean(rets)),
+                "episode_return_max": float(np.max(rets)),
+                "episode_return_min": float(np.min(rets)),
+                "episode_len_mean": float(np.mean(lens))}
+
+    def get_weights(self):
+        return jax.device_get(self.weights)
+
+    def set_weights(self, weights):
+        self.weights = weights

@@ -85,5 +85,18 @@ class SquashedGaussian:
         logp = self.base.log_prob(u) - correction - scale * u.shape[-1]
         return a, logp
 
+    def log_prob(self, a: jax.Array) -> jax.Array:
+        """Density of a squashed action (inverse-tanh change of variables);
+        needed by offline losses (CQL bc warmstart, BC on SAC data)."""
+        # unsquash: a -> u = atanh(2*(a-low)/(high-low) - 1), clipped inside
+        # the open interval so atanh stays finite on boundary actions
+        t = 2.0 * (a - self.low) / (self.high - self.low) - 1.0
+        t = jnp.clip(t, -1.0 + 1e-6, 1.0 - 1e-6)
+        u = jnp.arctanh(t)
+        correction = jnp.sum(
+            2.0 * (jnp.log(2.0) - u - jax.nn.softplus(-2.0 * u)), axis=-1)
+        scale = jnp.log((self.high - self.low) * 0.5 + 1e-8)
+        return self.base.log_prob(u) - correction - scale * u.shape[-1]
+
     def mode(self) -> jax.Array:
         return self._squash(self.base.mean)

@@ -166,6 +166,16 @@ class EnvRunner:
             out[SB.NEXT_OBS] = next_obs_buf
         return out
 
+    def run_eval(self, params, num_episodes: int) -> Dict:
+        """Sample until `num_episodes` complete; returns episode metrics.
+        One remote call per eval round so a dedicated evaluation actor runs
+        fully in parallel with training (reference: eval worker set)."""
+        self.set_weights(params)
+        self._completed = []
+        while len(self._completed) < num_episodes:
+            self.sample()
+        return self.pop_metrics()
+
     # -- metrics ------------------------------------------------------------
     def num_completed_episodes(self) -> int:
         return len(self._completed)

@@ -30,12 +30,14 @@ class JaxLearner:
         self.mesh = mesh
         self._metrics_keys = None
 
-        tx = []
-        clip = getattr(config, "grad_clip", None)
-        if clip:
-            tx.append(optax.clip_by_global_norm(clip))
-        tx.append(optax.adam(getattr(config, "lr", 3e-4)))
-        self.optimizer = optax.chain(*tx)
+        from ray_tpu.ops.optim import make_optimizer
+        self.optimizer, self._lr_schedule = make_optimizer(
+            lr=getattr(config, "lr", 3e-4),
+            lr_schedule=getattr(config, "lr_schedule", None),
+            optimizer=getattr(config, "optimizer", "adam"),
+            grad_clip=getattr(config, "grad_clip", None),
+            weight_decay=getattr(config, "weight_decay", 0.0))
+        self._num_updates = 0
 
         self.params = self.module.init(jax.random.PRNGKey(seed))
         self.opt_state = self.optimizer.init(self.params)
@@ -81,6 +83,8 @@ class JaxLearner:
             batch = jax.device_put(batch, self._data_sharding)
         self.params, self.opt_state, metrics = self._update(
             self.params, self.opt_state, batch)
+        metrics["cur_lr"] = float(self._lr_schedule(self._num_updates))
+        self._num_updates += 1
         return metrics
 
     def update(self, batch: SampleBatch) -> Dict[str, float]:

@@ -1,0 +1,77 @@
+"""Offline RL data path (reference: rllib/offline/ — JsonReader/DatasetReader
+feeding SampleBatches).
+
+Bridges `ray_tpu.data` Datasets and SampleBatch: recorded experience lives in
+parquet/arrow blocks (streamed, spillable) and trains offline algorithms
+(BC/MARWIL/CQL) without an environment. Multi-dim columns (obs, actions) are
+flattened per row for arrow and restored from a stored shape column.
+"""
+
+from typing import Dict, Optional, Union
+
+import numpy as np
+
+from . import sample_batch as SB
+from .sample_batch import SampleBatch
+
+_COLS = (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS, SB.TERMINATEDS)
+
+
+def sample_batch_to_dataset(batch: SampleBatch, num_blocks: int = 8):
+    """Flatten a SampleBatch into a ray_tpu.data Dataset (row-per-timestep)."""
+    import ray_tpu.data as rdata
+
+    cols: Dict[str, np.ndarray] = {}
+    shapes: Dict[str, tuple] = {}
+    n = None
+    for k in _COLS:
+        if k not in batch:
+            continue
+        v = np.asarray(batch[k])
+        if n is None:
+            n = len(v)
+        elif len(v) != n:
+            raise ValueError(f"column {k!r} has {len(v)} rows, expected {n} "
+                             f"(pass per-timestep columns, already flat)")
+        shapes[k] = v.shape[1:]
+        cols[k] = v.reshape(len(v), -1) if v.ndim > 1 else v
+    rows = []
+    for i in range(n):
+        row = {}
+        for k, v in cols.items():
+            val = v[i]
+            row[k] = val.tolist() if val.ndim else val.item()
+        rows.append(row)
+    ds = rdata.from_items(rows)
+    ds._offline_shapes = shapes  # advisory; parquet round-trips lose it
+    return ds
+
+
+def dataset_to_sample_batch(ds, shapes: Optional[Dict[str, tuple]] = None
+                            ) -> SampleBatch:
+    """Materialize a ray_tpu.data Dataset into one SampleBatch."""
+    import pyarrow as pa
+
+    shapes = shapes or getattr(ds, "_offline_shapes", {})
+    tables = list(ds._plan.iter_blocks())
+    whole = pa.concat_tables(tables) if len(tables) > 1 else tables[0]
+    out = {}
+    for k in whole.column_names:
+        col = whole[k].to_pylist()
+        arr = np.asarray(col, dtype=np.float32)
+        shape = shapes.get(k)
+        if shape:
+            arr = arr.reshape((len(arr),) + tuple(shape))
+        out[k] = arr
+    return SampleBatch(out)
+
+
+def as_sample_batch(data: Union[SampleBatch, dict, object]) -> SampleBatch:
+    """Accept SampleBatch | dict of arrays | ray_tpu.data Dataset."""
+    if isinstance(data, SampleBatch):
+        return data
+    if isinstance(data, dict):
+        return SampleBatch({k: np.asarray(v) for k, v in data.items()})
+    if hasattr(data, "_plan"):  # duck-typed Dataset
+        return dataset_to_sample_batch(data)
+    raise TypeError(f"unsupported offline data {type(data)!r}")

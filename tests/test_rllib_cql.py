@@ -1,0 +1,117 @@
+"""CQL + offline data path (VERDICT r2 #8; ref: rllib/algorithms/cql/cql.py)."""
+
+import numpy as np
+import pytest
+
+
+def _pendulum_dataset(n_steps=3000, seed=0):
+    """Offline experience from a simple energy-based Pendulum controller —
+    mediocre-but-informative data, the offline-RL setting."""
+    import gymnasium as gym
+    env = gym.make("Pendulum-v1")
+    rng = np.random.default_rng(seed)
+    obs_l, act_l, rew_l, nxt_l, done_l = [], [], [], [], []
+    obs, _ = env.reset(seed=seed)
+    for _ in range(n_steps):
+        cos_th, sin_th, vel = obs
+        # swing toward upright with noise; decent but far from optimal
+        a = np.clip(-1.0 * sin_th - 0.3 * vel + rng.normal(0, 0.4), -2, 2)
+        action = np.asarray([a], np.float32)
+        nxt, r, term, trunc, _ = env.step(action)
+        obs_l.append(obs)
+        act_l.append(action)
+        rew_l.append(r)
+        nxt_l.append(nxt)
+        done_l.append(float(term))
+        obs = nxt
+        if term or trunc:
+            obs, _ = env.reset()
+    env.close()
+    from ray_tpu.rllib import sample_batch as SB
+    return {SB.OBS: np.asarray(obs_l, np.float32),
+            SB.ACTIONS: np.asarray(act_l, np.float32),
+            SB.REWARDS: np.asarray(rew_l, np.float32),
+            SB.NEXT_OBS: np.asarray(nxt_l, np.float32),
+            SB.TERMINATEDS: np.asarray(done_l, np.float32)}
+
+
+def test_offline_dataset_roundtrip():
+    from ray_tpu.rllib.offline import (as_sample_batch,
+                                       dataset_to_sample_batch,
+                                       sample_batch_to_dataset)
+    from ray_tpu.rllib.sample_batch import SampleBatch
+    data = _pendulum_dataset(n_steps=200)
+    ds = sample_batch_to_dataset(SampleBatch(data))
+    back = dataset_to_sample_batch(ds)
+    for k, v in data.items():
+        np.testing.assert_allclose(back[k], v, rtol=1e-6)
+    # Dataset accepted directly as offline_data
+    b = as_sample_batch(ds)
+    assert b[next(iter(data))].shape == data[next(iter(data))].shape
+
+
+def test_cql_trains_and_stays_conservative():
+    from ray_tpu.rllib import CQLConfig
+    data = _pendulum_dataset(n_steps=2000)
+    algo = (CQLConfig()
+            .environment("Pendulum-v1")
+            .offline_data_source(data)
+            .training(lr=3e-4, train_batch_size=256, cql_alpha=1.0,
+                      num_cql_actions=4, train_intensity=10, bc_iters=10)
+            .evaluation(evaluation_duration=2)
+            .debugging(seed=7)
+            .build())
+    penalties = []
+    for _ in range(4):
+        result = algo.train()
+        learner = result["learner"]
+        assert np.isfinite(learner["critic_loss"]), learner
+        assert np.isfinite(learner["actor_loss"]), learner
+        penalties.append(learner["cql_penalty"])
+    assert all(np.isfinite(p) for p in penalties), penalties
+    ev = algo.evaluate()
+    assert ev["episodes_this_iter"] == 2
+    assert np.isfinite(ev["episode_return_mean"])
+
+    # ablation: with the penalty OFF, the conservative gap (logsumexp Q over
+    # sampled actions minus Q on data) ends up larger — the regularizer is
+    # demonstrably doing its job
+    ablation = (CQLConfig()
+                .environment("Pendulum-v1")
+                .offline_data_source(data)
+                .training(lr=3e-4, train_batch_size=256, cql_alpha=0.0,
+                          num_cql_actions=4, train_intensity=10, bc_iters=10)
+                .debugging(seed=7)
+                .build())
+    gap_off = None
+    for _ in range(4):
+        gap_off = ablation.train()["learner"]["cql_penalty"]
+    assert penalties[-1] < gap_off, (penalties[-1], gap_off)
+
+
+def test_cql_not_worse_than_bc_smoke():
+    """d4rl-style smoke comparison on the same dataset (generous slack: 4
+    training iterations on 2k transitions is a smoke test, not a paper)."""
+    from ray_tpu.rllib import BCConfig, CQLConfig
+    data = _pendulum_dataset(n_steps=2000)
+
+    bc = (BCConfig().environment("Pendulum-v1")
+          .offline_data_source(data)
+          .training(lr=1e-3, train_batch_size=256)
+          .evaluation(evaluation_duration=3)
+          .debugging(seed=7).build())
+    for _ in range(8):
+        bc.train()
+    bc_ret = bc.evaluate().get("episode_return_mean", -1e9)
+
+    cql = (CQLConfig().environment("Pendulum-v1")
+           .offline_data_source(data)
+           .training(lr=3e-4, train_batch_size=256, cql_alpha=1.0,
+                     num_cql_actions=4, train_intensity=20, bc_iters=20)
+           .evaluation(evaluation_duration=3)
+           .debugging(seed=7).build())
+    for _ in range(8):
+        cql.train()
+    cql_ret = cql.evaluate()["episode_return_mean"]
+    # Pendulum returns ~[-1800, 0]; CQL should be in BC's league or better
+    assert cql_ret > bc_ret - 400, (cql_ret, bc_ret)

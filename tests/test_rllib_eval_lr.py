@@ -1,0 +1,126 @@
+"""Evaluation workers + LR schedules (VERDICT r2 #5; ref:
+rllib/algorithms/algorithm.py eval worker set, rllib/core/learner lr_schedule)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def _slow_cartpole(sleep_s=0.002):
+    import gymnasium as gym
+
+    class SlowCartPole(gym.Wrapper):
+        def __init__(self):
+            super().__init__(gym.make("CartPole-v1"))
+
+        def step(self, action):
+            time.sleep(sleep_s)
+            return self.env.step(action)
+
+    return SlowCartPole
+
+
+def test_lr_schedule_shapes():
+    from ray_tpu.ops.optim import make_lr_schedule
+    cos = make_lr_schedule(1e-3, {"type": "cosine", "warmup_steps": 10,
+                                  "decay_steps": 100})
+    assert float(cos(0)) == pytest.approx(0.0, abs=1e-8)
+    assert float(cos(10)) == pytest.approx(1e-3, rel=1e-5)
+    assert float(cos(100)) == pytest.approx(0.0, abs=1e-6)
+    assert float(cos(55)) < 1e-3
+
+    lin = make_lr_schedule(2e-3, {"type": "linear", "warmup_steps": 4,
+                                  "decay_steps": 20, "final_lr_scale": 0.1})
+    assert float(lin(4)) == pytest.approx(2e-3, rel=1e-5)
+    assert float(lin(20)) == pytest.approx(2e-4, rel=1e-4)
+    assert float(lin(1000)) == pytest.approx(2e-4, rel=1e-4)
+
+    pw = make_lr_schedule(1.0, [[0, 1.0], [10, 0.5], [20, 0.0]])
+    assert float(pw(5)) == pytest.approx(0.75, rel=1e-5)
+    assert float(pw(15)) == pytest.approx(0.25, rel=1e-5)
+
+
+def test_ppo_logs_warmup_cosine_lr(ray_session):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .training(lr=1e-3, train_batch_size=128, minibatch_size=64,
+                      num_epochs=1,
+                      lr_schedule={"type": "cosine", "warmup_steps": 3,
+                                   "decay_steps": 30})
+            .env_runners(rollout_fragment_length=64)
+            .build())
+    lrs = []
+    for _ in range(3):
+        result = algo.train()
+        lrs.append(result["learner"]["cur_lr"])
+    # warmup: lr climbs over the first updates
+    assert lrs[0] < lrs[-1] <= 1e-3 + 1e-9, lrs
+
+
+def test_parallel_eval_does_not_block_train(ray_session):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+    def build(parallel, n_eval):
+        return (PPOConfig()
+                .environment(_slow_cartpole())
+                .training(lr=1e-3, train_batch_size=64, minibatch_size=64,
+                          num_epochs=1)
+                .env_runners(rollout_fragment_length=32)
+                .evaluation(evaluation_interval=1, evaluation_duration=10,
+                            evaluation_num_env_runners=n_eval,
+                            evaluation_parallel_to_training=parallel)
+                .build())
+
+    # inline baseline: evaluation blocks the iteration
+    inline = build(parallel=False, n_eval=0)
+    inline.train()  # warm up (env creation, jit)
+    t0 = time.perf_counter()
+    r_inline = inline.train()
+    inline_time = time.perf_counter() - t0
+    assert "evaluation" in r_inline
+
+    par = build(parallel=True, n_eval=1)
+    r1 = par.train()  # launches eval in the dedicated actor
+    t0 = time.perf_counter()
+    r2 = par.train()
+    par_time = time.perf_counter() - t0
+    assert "evaluation" not in r1
+    # results attach once ready (forced at the next due interval)
+    attached = ("evaluation" in r2) or ("evaluation" in par.train())
+    assert attached
+    # the launching iteration didn't pay the eval wall-time
+    assert par_time < inline_time * 2, (par_time, inline_time)
+
+
+def test_eval_metrics_from_dedicated_workers(ray_session):
+    from ray_tpu.rllib.algorithms.ppo import PPOConfig
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .training(lr=1e-3, train_batch_size=64, minibatch_size=64,
+                      num_epochs=1)
+            .env_runners(rollout_fragment_length=32)
+            .evaluation(evaluation_interval=1, evaluation_duration=4,
+                        evaluation_num_env_runners=2)
+            .build())
+    ev = algo.evaluate()
+    assert ev["episodes_this_iter"] >= 4
+    assert np.isfinite(ev["episode_return_mean"])
+
+
+def test_sac_eval_actors_use_module_override(ray_session):
+    """Code-review regression: dedicated eval runners must be built with the
+    algorithm's runner kwargs (SAC's module override), not generic ones."""
+    from ray_tpu.rllib import SACConfig
+    algo = (SACConfig()
+            .environment("Pendulum-v1")
+            .training(train_batch_size=64,
+                      num_steps_sampled_before_learning_starts=64)
+            .env_runners(rollout_fragment_length=16)
+            .evaluation(evaluation_interval=1, evaluation_duration=1,
+                        evaluation_num_env_runners=1)
+            .debugging(seed=3)
+            .build())
+    ev = algo.evaluate()  # crashes without the module override
+    assert ev["episodes_this_iter"] >= 1
