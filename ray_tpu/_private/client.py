@@ -153,6 +153,12 @@ class DriverClient(BaseClient):
         return (self._call_soon(lambda: dict(self.controller.total)),
                 self._call_soon(lambda: dict(self.controller.available)))
 
+    def request_resources(self, num_cpus=None, bundles=None):
+        return self._call_soon(self.controller.request_resources, num_cpus, bundles)
+
+    def autoscaler_status(self):
+        return self._call_soon(self.controller.autoscaler_status)
+
     def object_sizes(self, oids):
         """Registered byte sizes (0 for unknown ids) — cheap metadata read used
         by the data streaming executor's memory accounting."""
@@ -397,6 +403,16 @@ class WorkerClient(BaseClient):
     def resources(self):
         p = self._rpc("resources")
         return p["total"], p["available"]
+
+    def request_resources(self, num_cpus=None, bundles=None):
+        p = self._rpc("request_resources", num_cpus=num_cpus, bundles=bundles)
+        p.pop("req_id", None)
+        return p
+
+    def autoscaler_status(self):
+        p = self._rpc("autoscaler_status")
+        p.pop("req_id", None)
+        return p
 
     def object_sizes(self, oids):
         return self._rpc("obj_sizes", oids=oids)["sizes"]

@@ -24,6 +24,7 @@ from typing import Dict, List, Optional, Set
 from .. import exceptions as exc
 from . import ids, protocol
 from .object_store import StoreClient
+from .runtime_env import runtime_env_key
 from .task_spec import ObjectMeta, TaskSpec
 
 # Scheduling states
@@ -89,6 +90,9 @@ class WorkerConn:
     # platform library can block on the chip while another process computes,
     # so plain workers must never touch it)
     tpu_capable: bool = False
+    # runtime_env content hash this worker was built for (None = default env);
+    # tasks only dispatch to workers whose env_key matches theirs
+    env_key: Optional[str] = None
     # actor handle / stream refs this worker's deserialized handles hold;
     # reconciled (released) if the worker dies without the matching decrefs
     actor_refs: Dict[str, int] = field(default_factory=dict)
@@ -176,6 +180,14 @@ class Controller:
         self.lineage_specs: "collections.OrderedDict[str, tuple]" = collections.OrderedDict()
         self.timeline_events: collections.deque = collections.deque(
             maxlen=int(os.environ.get("RAY_TPU_TIMELINE_RETENTION", "20000")))
+        # runtime_env builder (py_modules/pip/working_dir staging, hash-cached)
+        from .runtime_env import RuntimeEnvManager
+        self.runtime_envs = RuntimeEnvManager()
+        # autoscaler hook: last explicit resource request (sdk.request_resources)
+        self.resource_requests: Dict = {}
+        # env keys with an async build in flight (built off-loop: a pip venv
+        # install can take minutes and must not freeze the controller)
+        self._env_building: Set[str] = set()
 
     # ------------------------------------------------------------------ setup
     async def start(self):
@@ -355,6 +367,11 @@ class Controller:
             self._reply(w, p["req_id"], ok=True)
         elif kind == "resources":
             self._reply(w, p["req_id"], total=dict(self.total), available=dict(self.available))
+        elif kind == "request_resources":
+            self._reply(w, p["req_id"],
+                        **self.request_resources(p.get("num_cpus"), p.get("bundles")))
+        elif kind == "autoscaler_status":
+            self._reply(w, p["req_id"], **self.autoscaler_status())
         elif kind == "actor_exit":
             # graceful exit_actor(): mark dead without restart
             actor = self.actors.get(p["actor_id"])
@@ -526,11 +543,11 @@ class Controller:
                     self.ready_queue.append(rec)
                     continue
                 if rec.spec.is_actor_creation:
-                    self._start_actor_worker(rec, pool)
-                    progressing = True
+                    progressing = self._start_actor_worker(rec, pool) or progressing
                     continue
                 w = self._find_idle_worker(
-                    need_tpu=rec.spec.resources.get("TPU", 0) > 0)
+                    need_tpu=rec.spec.resources.get("TPU", 0) > 0,
+                    env_key=runtime_env_key(rec.spec.runtime_env))
                 if w is None:
                     self.ready_queue.append(rec)
                     continue
@@ -538,17 +555,22 @@ class Controller:
                 self._assign_tpus(rec)
                 self._dispatch(rec, w)
                 progressing = True
-        # spawn workers to match queued demand (never more than cpu slots)
-        demand = tpu_demand = 0
+        # spawn workers to match queued demand (never more than cpu slots),
+        # grouped by runtime_env so each env gets workers built for it
+        demand: Dict[Optional[str], int] = {}
+        tpu_demand: Dict[Optional[str], int] = {}
+        env_specs: Dict[Optional[str], Optional[dict]] = {}
         for rec in self.ready_queue:
             if (rec.state == PENDING and not rec.spec.is_actor_creation
                     and self._resources_fit(rec.spec.resources,
                                             self._task_pool(rec.spec))):
+                key = runtime_env_key(rec.spec.runtime_env)
+                env_specs.setdefault(key, rec.spec.runtime_env)
                 if rec.spec.resources.get("TPU", 0) > 0:
-                    tpu_demand += 1
+                    tpu_demand[key] = tpu_demand.get(key, 0) + 1
                 else:
-                    demand += 1
-        self._spawn_for_demand(demand, tpu_demand)
+                    demand[key] = demand.get(key, 0) + 1
+        self._spawn_for_demand(demand, tpu_demand, env_specs)
         # 2. actor method calls → their dedicated workers
         for actor in self.actors.values():
             if actor.state != A_ALIVE:
@@ -564,30 +586,156 @@ class Controller:
                 actor.in_flight.add(rec.spec.task_id)
                 self._dispatch(rec, w)
 
-    def _find_idle_worker(self, need_tpu: bool = False) -> Optional[WorkerConn]:
+    def _find_idle_worker(self, need_tpu: bool = False,
+                          env_key: Optional[str] = None) -> Optional[WorkerConn]:
         for w in self.workers.values():
-            if w.state == "idle" and w.actor_id is None and w.tpu_capable == need_tpu:
+            if (w.state == "idle" and w.actor_id is None
+                    and w.tpu_capable == need_tpu and w.env_key == env_key):
                 return w
         return None
 
-    def _spawn_for_demand(self, demand: int, tpu_demand: int = 0):
-        spawning = sum(1 for w in self.spawning.values()
-                       if w.actor_id is None and not w.tpu_capable)
+    def _fail_env_tasks(self, env_key: Optional[str], err: Exception):
+        """Runtime env build failed: fail every queued task/actor needing it."""
+        for rec in list(self.ready_queue):
+            if (rec.state == PENDING
+                    and runtime_env_key(rec.spec.runtime_env) == env_key):
+                if rec.spec.is_actor_creation:
+                    actor = self.actors.get(rec.spec.actor_id)
+                    if actor is not None:
+                        self._fail_actor(actor, f"runtime_env setup failed: {err}",
+                                         allow_restart=False)
+                else:
+                    self._fail_task(rec, exc.RuntimeEnvSetupError(str(err)))
+
+    def _env_ready(self, runtime_env: Optional[dict]) -> bool:
+        """True when the task's runtime env is built (default env counts).
+        Otherwise kicks an off-loop build (venv creation + pip installs run
+        in an executor thread; the event loop keeps scheduling everything
+        else) and returns False — the caller leaves the work queued, and the
+        completion callback re-runs _schedule."""
+        key = runtime_env_key(runtime_env)
+        if self.runtime_envs.is_built(key):
+            return True
+        if key in self._env_building:
+            return False
+        self._env_building.add(key)
+        fut = self.loop.run_in_executor(
+            None, self.runtime_envs.get_context, runtime_env)
+
+        def _done(f):
+            self._env_building.discard(key)
+            err = f.exception()
+            if err is not None:
+                self._fail_env_tasks(key, err)
+            self._schedule()
+
+        fut.add_done_callback(_done)
+        return False
+
+    def _spawn_for_demand(self, demand: Dict[Optional[str], int],
+                          tpu_demand: Dict[Optional[str], int],
+                          env_specs: Dict[Optional[str], Optional[dict]]):
         n_alive = sum(1 for w in list(self.workers.values()) + list(self.spawning.values())
                       if w.actor_id is None and w.state not in ("dead", "driver"))
         n_blocked = sum(1 for w in self.workers.values()
                         if w.actor_id is None and w.blocked_tasks)
         headroom = self.max_workers - (n_alive - n_blocked)
-        for _ in range(max(0, min(demand - spawning, headroom))):
-            self._spawn_worker()
+        for env_key, n in demand.items():
+            if not self._env_ready(env_specs.get(env_key)):
+                continue  # async build in flight; tasks stay queued
+            spawning = sum(1 for w in self.spawning.values()
+                           if w.actor_id is None and not w.tpu_capable
+                           and w.env_key == env_key)
+            for _ in range(max(0, n - spawning)):
+                if headroom <= 0:
+                    # pool full of OTHER envs' idle workers → recycle one, or
+                    # this env's demand would starve forever (workers are
+                    # env-dedicated; cross-env dispatch is never allowed)
+                    victim = next(
+                        (w for w in self.workers.values()
+                         if w.state == "idle" and w.actor_id is None
+                         and not w.tpu_capable and w.env_key != env_key),
+                        None)
+                    if victim is None:
+                        break
+                    self._kill_worker_proc(victim)
+                    # not "dead" (that's _on_worker_dead's transition) but no
+                    # longer dispatchable while the kill is in flight
+                    victim.state = "dying"
+                    headroom += 1
+                try:
+                    self._spawn_worker(env_key=env_key,
+                                       runtime_env=env_specs.get(env_key))
+                except Exception as e:  # noqa: BLE001 - env build failure
+                    self._fail_env_tasks(env_key, e)
+                    break
+                headroom -= 1
         # TPU pool-workers: one persistent worker serves the chip queue (a
         # second process can't initialize the platform while the first
-        # computes, so more would just block at startup)
-        if tpu_demand > 0:
-            have = sum(1 for w in list(self.workers.values()) + list(self.spawning.values())
-                       if w.actor_id is None and w.tpu_capable and w.state != "dead")
-            if have == 0:
-                self._spawn_worker(tpu_capable=True)
+        # computes, so more would just block at startup). If the sole worker
+        # was built for a different runtime_env and sits idle, recycle it.
+        for env_key in tpu_demand:
+            tpu_workers = [
+                w for w in list(self.workers.values()) + list(self.spawning.values())
+                if w.actor_id is None and w.tpu_capable and w.state != "dead"]
+            if any(w.env_key == env_key for w in tpu_workers):
+                continue
+            if any(w.state != "idle" or w.running for w in tpu_workers):
+                # a busy OR still-starting worker owns the chip; never run
+                # two processes against the platform at once
+                continue
+            if not self._env_ready(env_specs.get(env_key)):
+                continue
+            for w in tpu_workers:
+                self._kill_worker_proc(w)
+                w.state = "dying"
+            try:
+                self._spawn_worker(tpu_capable=True, env_key=env_key,
+                                   runtime_env=env_specs.get(env_key))
+            except Exception as e:  # noqa: BLE001
+                self._fail_env_tasks(env_key, e)
+            break
+
+    # ------------------------------------------------------------ autoscaler
+    def request_resources(self, num_cpus=None, bundles=None) -> dict:
+        """Autoscaler hook (ref: python/ray/autoscaler/sdk.py
+        request_resources → autoscaler/_private/autoscaler.py:1-1572). The
+        reference records the demand and adds nodes; on one host the
+        "cluster" is the worker pool, so meeting the request means warming
+        idle CPU workers up to it, bounded by max_workers. Overwrite
+        semantics (a new call replaces the prior request), like the
+        reference. Returns what was fulfilled vs clamped."""
+        target = int(num_cpus or 0)
+        for b in bundles or []:
+            target += int(b.get("CPU", 0) or 0)
+        self.resource_requests = {
+            "num_cpus": num_cpus, "bundles": bundles, "target_cpus": target,
+            "ts": time.time()}
+        n_alive = sum(
+            1 for w in list(self.workers.values()) + list(self.spawning.values())
+            if w.actor_id is None and not w.tpu_capable
+            and w.state not in ("dead", "driver"))
+        want = min(target, self.max_workers)
+        spawned = 0
+        for _ in range(max(0, want - n_alive)):
+            self._spawn_worker()
+            spawned += 1
+        return {"target_cpus": target, "fulfilled_cpus": want,
+                "clamped": target > want, "spawned_workers": spawned}
+
+    def autoscaler_status(self) -> dict:
+        workers = list(self.workers.values()) + list(self.spawning.values())
+        pool = [w for w in workers if w.actor_id is None
+                and w.state not in ("dead", "driver")]
+        return {
+            "request": dict(self.resource_requests),
+            "max_workers": self.max_workers,
+            "pool_workers": len(pool),
+            "idle_workers": sum(1 for w in pool if w.state == "idle"),
+            "pending_tasks": len(self.ready_queue),
+            "total": dict(self.total),
+            "available": dict(self.available),
+        }
 
     # env vars that bind a process to the accelerator runtime; stripped for
     # CPU-only workers (see WorkerConn.tpu_capable). Single source of truth:
@@ -595,7 +743,15 @@ class Controller:
     from ..util.tpu import ACCEL_ENV_KEYS as _TPU_ENV_KEYS
 
     def _spawn_worker(self, actor: ActorRecord = None,
-                      tpu_capable: bool = False) -> WorkerConn:
+                      tpu_capable: bool = False,
+                      env_key: Optional[str] = None,
+                      runtime_env: Optional[dict] = None) -> WorkerConn:
+        if actor is not None and actor.creation_spec is not None:
+            runtime_env = actor.creation_spec.runtime_env
+            env_key = runtime_env_key(runtime_env)
+        # build (or fetch cached) runtime env BEFORE claiming a worker id —
+        # raises on bad py_modules paths / failed pip installs
+        renv_ctx = self.runtime_envs.get_context(runtime_env)
         wid = ids.worker_id()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = wid
@@ -616,24 +772,36 @@ class Controller:
             for k in self._TPU_ENV_KEYS:
                 env.pop(k, None)
             env["JAX_PLATFORMS"] = "cpu"
+        renv_ctx.apply(env)  # env_vars, staged py_modules/working_dir paths
         proc = subprocess.Popen(
-            [sys.executable, "-m", "ray_tpu._private.worker_main", self.socket_path, wid],
+            [renv_ctx.python_exe, "-m", "ray_tpu._private.worker_main",
+             self.socket_path, wid],
             env=env, stdin=subprocess.DEVNULL)
         w = WorkerConn(worker_id=wid, proc=proc,
                        actor_id=actor.actor_id if actor else None,
-                       tpu_capable=tpu_capable)
+                       tpu_capable=tpu_capable, env_key=env_key)
         self.spawning[wid] = w
         return w
 
-    def _start_actor_worker(self, rec: TaskRecord, pool: Dict[str, float]):
+    def _start_actor_worker(self, rec: TaskRecord, pool: Dict[str, float]) -> bool:
         """Actor creation always gets a dedicated worker (ref: raylet leases a
-        worker for the actor's lifetime). TPU actors get chip binding env."""
+        worker for the actor's lifetime). TPU actors get chip binding env.
+        Returns False (rec left queued) while its runtime env is still
+        building asynchronously."""
+        if not self._env_ready(rec.spec.runtime_env):
+            self.ready_queue.append(rec)
+            return False
         self._claim(rec.spec.resources, pool)
         actor = self.actors[rec.spec.actor_id]
         actor.resources_claimed = True
         rec.state = "SPAWNING"
         self._assign_tpus(rec, actor)
-        self._spawn_worker(actor)
+        try:
+            self._spawn_worker(actor)
+        except Exception as e:  # noqa: BLE001 - runtime_env build failure
+            self._fail_actor(actor, f"runtime_env setup failed: {e}",
+                             allow_restart=False)
+        return True
 
     def _assign_tpus(self, rec: TaskRecord, actor: ActorRecord = None):
         n = int(rec.spec.resources.get("TPU", 0))
@@ -1495,4 +1663,8 @@ class Controller:
             return [{"node_id": self.node_id, "alive": True, "resources": dict(self.total),
                      "available": dict(self.available), "object_store_used": self.store_used,
                      "object_store_capacity": self.store_capacity}]
+        if kind == "placement_groups":
+            return [{"pg_id": pg.pg_id, "name": pg.name, "strategy": pg.strategy,
+                     "bundles": [dict(b.resources) for b in pg.bundles]}
+                    for pg in self.pgroups.values()]
         raise ValueError(f"unknown state kind {kind}")

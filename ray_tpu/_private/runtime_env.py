@@ -1,0 +1,249 @@
+"""Runtime environments: per-task/actor env_vars, py_modules, pip, working_dir.
+
+Reference parity: python/ray/_private/runtime_env/ (pip.py:1-344 builds a
+virtualenv per env hash; py_modules.py stages module dirs; working_dir.py
+stages + chdirs). The reference runs a per-node agent that builds envs
+asynchronously and leases dedicated workers per env; here the single-host
+controller builds envs inline (cached by content hash, so the cost is
+first-use only) and tags workers with the env key so tasks only dispatch to
+workers built for their environment.
+
+Supported keys in the `runtime_env` dict:
+  env_vars:    {str: str} exported into the worker process environment.
+  py_modules:  [path, ...] local module dirs / single .py files, staged into
+               the env cache and prepended to the worker's PYTHONPATH.
+  working_dir: path — staged (copied) and used as the worker's cwd; also on
+               sys.path, matching the reference's working_dir semantics.
+  pip:         [req, ...] or {"packages": [...], "pip_install_options": [...]}
+               — builds a venv (--system-site-packages, so jax and ray_tpu
+               stay importable) keyed by the request hash and runs the worker
+               under its interpreter. Installs honor the options list, e.g.
+               ["--no-index", "--no-build-isolation"] for air-gapped installs
+               from local paths.
+Internal key `_tpu_ids` (chip binding) is ignored for hashing/building.
+"""
+
+import hashlib
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+_IGNORED_KEYS = {"_tpu_ids", "_content_key"}
+_SUPPORTED = {"env_vars", "py_modules", "working_dir", "pip"}
+
+
+def _path_digest(path: str) -> str:
+    """Stat digest of a file or directory tree (relative names + sizes +
+    mtime_ns), so editing user code yields a new env key and re-stages (the
+    reference hashes working_dir/py_modules for the same reason). Stat-based
+    rather than content-based: runtime_env_key runs on the controller event
+    loop, and walking stats is O(entries) while hashing bytes would be
+    O(total size) — a multi-GB working_dir must not freeze the loop."""
+    path = os.path.abspath(os.path.expanduser(path))
+    h = hashlib.sha1()
+    try:
+        st = os.stat(path)
+    except OSError:
+        return f"missing:{path}"  # build will raise; key just needs to differ
+    if os.path.isfile(path):
+        h.update(f"{st.st_size}:{st.st_mtime_ns}".encode())
+        return h.hexdigest()
+    for root, dirs, files in os.walk(path):
+        dirs.sort()
+        for name in sorted(files):
+            fp = os.path.join(root, name)
+            h.update(os.path.relpath(fp, path).encode())
+            try:
+                fst = os.stat(fp)
+                h.update(f"{fst.st_size}:{fst.st_mtime_ns}".encode())
+            except OSError:
+                h.update(b"<unreadable>")
+    return h.hexdigest()
+
+
+@dataclass
+class RuntimeEnvContext:
+    """Resolved, built environment, ready to apply to a worker spawn."""
+    key: Optional[str] = None
+    env_vars: Dict[str, str] = field(default_factory=dict)
+    pythonpath: List[str] = field(default_factory=list)  # prepended
+    working_dir: Optional[str] = None
+    python_exe: str = sys.executable
+
+    def apply(self, env: Dict[str, str]) -> Dict[str, str]:
+        """Merge this context into a worker-process environment dict."""
+        env.update({k: str(v) for k, v in self.env_vars.items()})
+        if self.pythonpath:
+            prev = env.get("PYTHONPATH")
+            env["PYTHONPATH"] = os.pathsep.join(
+                self.pythonpath + ([prev] if prev else []))
+        if self.working_dir:
+            env["RAY_TPU_WORKING_DIR"] = self.working_dir
+        return env
+
+
+def runtime_env_key(runtime_env: Optional[dict]) -> Optional[str]:
+    """Stable content hash of a runtime_env dict; None for the default env.
+
+    Local-path entries (py_modules, working_dir) are digested by tree state
+    (names/sizes/mtimes), not path string, and memoized into the dict under
+    `_content_key` — the scheduler calls this per pending task per pass. The
+    spec's dict is a per-submission copy (see remote_function/actor), so the
+    memo freezes the env at submit time without mutating the user's dict,
+    and a resubmission after an edit re-digests (reference semantics)."""
+    if not runtime_env:
+        return None
+    cached = runtime_env.get("_content_key")
+    if cached is not None:
+        return cached or None  # "" memoizes the env_vars-less empty case
+    payload = {k: v for k, v in runtime_env.items() if k not in _IGNORED_KEYS}
+    if not payload:
+        runtime_env["_content_key"] = ""
+        return None
+    digests = [_path_digest(m) for m in payload.get("py_modules") or []]
+    if payload.get("working_dir"):
+        digests.append(_path_digest(payload["working_dir"]))
+    blob = json.dumps([payload, digests], sort_keys=True, default=str).encode()
+    key = hashlib.sha1(blob).hexdigest()[:16]
+    runtime_env["_content_key"] = key
+    return key
+
+
+class RuntimeEnvManager:
+    """Builds and caches runtime environments by content hash.
+
+    Cache layout: <root>/<key>/{py_modules/, working_dir/, venv/}. The cache
+    root survives the session (like the reference's conda/pip cache), so a
+    rebuilt cluster reuses prior venvs.
+    """
+
+    def __init__(self, cache_root: Optional[str] = None):
+        self.cache_root = cache_root or os.path.join(
+            tempfile.gettempdir(), "ray_tpu_runtime_envs")
+        self._contexts: Dict[str, RuntimeEnvContext] = {}
+
+    def is_built(self, key: Optional[str]) -> bool:
+        return key is None or key in self._contexts
+
+    def get_context(self, runtime_env: Optional[dict]) -> RuntimeEnvContext:
+        key = runtime_env_key(runtime_env)
+        if key is None:
+            return RuntimeEnvContext()
+        if key in self._contexts:
+            return self._contexts[key]
+        unknown = set(runtime_env) - _SUPPORTED - _IGNORED_KEYS
+        if unknown:
+            raise ValueError(
+                f"unsupported runtime_env keys: {sorted(unknown)} "
+                f"(supported: {sorted(_SUPPORTED)})")
+        ctx = self._build(key, runtime_env)
+        self._contexts[key] = ctx
+        return ctx
+
+    # ------------------------------------------------------------------ build
+    def _build(self, key: str, runtime_env: dict) -> RuntimeEnvContext:
+        ctx = RuntimeEnvContext(key=key)
+        ctx.env_vars = dict(runtime_env.get("env_vars") or {})
+        env_dir = os.path.join(self.cache_root, key)
+        os.makedirs(env_dir, exist_ok=True)
+
+        mods = runtime_env.get("py_modules") or []
+        if mods:
+            ctx.pythonpath.append(self._stage_py_modules(env_dir, mods))
+
+        wd = runtime_env.get("working_dir")
+        if wd:
+            ctx.working_dir = self._stage_working_dir(env_dir, wd)
+            ctx.pythonpath.append(ctx.working_dir)
+
+        pip = runtime_env.get("pip")
+        if pip:
+            ctx.python_exe = self._build_pip_venv(env_dir, pip)
+        return ctx
+
+    def _stage_py_modules(self, env_dir: str, modules) -> str:
+        """Copy each module (dir or .py file) under <env>/py_modules/; the
+        staging dir goes on PYTHONPATH so `import <basename>` resolves."""
+        stage = os.path.join(env_dir, "py_modules")
+        if not os.path.isdir(stage):
+            tmp = stage + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            os.makedirs(tmp)
+            for m in modules:
+                m = os.path.abspath(os.path.expanduser(m))
+                if not os.path.exists(m):
+                    shutil.rmtree(tmp, ignore_errors=True)
+                    raise FileNotFoundError(f"py_modules entry not found: {m}")
+                dst = os.path.join(tmp, os.path.basename(m))
+                if os.path.isdir(m):
+                    shutil.copytree(m, dst)
+                else:
+                    shutil.copy2(m, dst)
+            os.rename(tmp, stage)  # atomic publish: never a half-staged dir
+        return stage
+
+    def _stage_working_dir(self, env_dir: str, wd: str) -> str:
+        src = os.path.abspath(os.path.expanduser(wd))
+        if not os.path.isdir(src):
+            raise FileNotFoundError(f"working_dir not found: {src}")
+        stage = os.path.join(env_dir, "working_dir")
+        if not os.path.isdir(stage):
+            tmp = stage + ".tmp"
+            shutil.rmtree(tmp, ignore_errors=True)
+            shutil.copytree(src, tmp)
+            os.rename(tmp, stage)
+        return stage
+
+    def _build_pip_venv(self, env_dir: str, pip) -> str:
+        """Create <env>/venv (--system-site-packages) and install packages.
+
+        Returns the venv's python. Ref: python/ray/_private/runtime_env/pip.py
+        builds a virtualenv per hash with inherited site-packages — same
+        shape; the install command is logged to <env>/pip.log.
+        """
+        if isinstance(pip, dict):
+            packages = list(pip.get("packages") or [])
+            options = list(pip.get("pip_install_options") or [])
+        else:
+            packages = list(pip)
+            options = []
+        venv_dir = os.path.join(env_dir, "venv")
+        py = os.path.join(venv_dir, "bin", "python")
+        done = os.path.join(venv_dir, ".ray_tpu_ready")
+        if os.path.exists(done):
+            return py
+        shutil.rmtree(venv_dir, ignore_errors=True)
+        subprocess.run(
+            [sys.executable, "-m", "venv", "--system-site-packages", venv_dir],
+            check=True, capture_output=True)
+        # venvs don't nest: when THIS interpreter is itself a venv, the new
+        # venv's "system site" resolves to the base python, hiding our
+        # site-packages (jax, setuptools, ...). A .pth re-links them; venv
+        # site-packages still precedes it, so installs shadow the parent's.
+        purelib = os.path.join(
+            venv_dir, "lib", f"python{sys.version_info[0]}.{sys.version_info[1]}",
+            "site-packages")
+        parent_sites = [p for p in sys.path
+                        if p.endswith("site-packages") and os.path.isdir(p)]
+        if parent_sites and os.path.isdir(purelib):
+            with open(os.path.join(purelib, "_ray_tpu_parent.pth"), "w") as f:
+                f.write("\n".join(parent_sites) + "\n")
+        if packages:
+            cmd = [py, "-m", "pip", "install", "--no-input",
+                   "--disable-pip-version-check"] + options + packages
+            with open(os.path.join(env_dir, "pip.log"), "wb") as log:
+                r = subprocess.run(cmd, stdout=log, stderr=subprocess.STDOUT)
+            if r.returncode != 0:
+                tail = open(os.path.join(env_dir, "pip.log"), "rb").read()[-2000:]
+                shutil.rmtree(venv_dir, ignore_errors=True)
+                raise RuntimeError(
+                    f"runtime_env pip install failed (rc={r.returncode}): "
+                    f"{' '.join(cmd)}\n{tail.decode(errors='replace')}")
+        with open(done, "w") as f:
+            f.write("ok")
+        return py

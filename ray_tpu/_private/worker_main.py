@@ -10,6 +10,7 @@ here — tasks that need it import it themselves, keeping worker cold-start
 
 import asyncio
 import inspect
+import os
 import sys
 import threading
 import traceback
@@ -165,6 +166,11 @@ def main():
     import faulthandler
     import signal
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # runtime_env working_dir: the controller staged a copy and points us at
+    # it (ref: working_dir semantics in python/ray/_private/runtime_env)
+    wd = os.environ.get("RAY_TPU_WORKING_DIR")
+    if wd and os.path.isdir(wd):
+        os.chdir(wd)
     socket_path, worker_id = sys.argv[1], sys.argv[2]
     client = WorkerClient(socket_path, worker_id)
     state.set_global_client(client)

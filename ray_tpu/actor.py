@@ -186,7 +186,8 @@ class ActorClass:
             name=f"{self.__name__}.__init__",
             actor_id=actor_id,
             is_actor_creation=True,
-            runtime_env=opts.get("runtime_env"),
+            # per-submission copy (see remote_function.py: env-key memo)
+            runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,
             job_id=client.job_id,
         )
         eargs, ekwargs, nested = encode_call(args, kwargs)

@@ -1,0 +1,14 @@
+"""Autoscaler surface (ref: python/ray/autoscaler/).
+
+Single-host TPU design: the reference autoscaler adds cloud nodes to meet
+resource demand (autoscaler/_private/autoscaler.py:1-1572); here the unit of
+elasticity is the worker-process pool, which the controller already scales
+demand-driven. This package exposes the explicit-demand hooks
+(`sdk.request_resources`) and observability (`sdk.status`) with reference
+semantics: requests overwrite, are clamped to what the host can fulfil, and
+warm workers ahead of the tasks that need them.
+"""
+
+from ray_tpu.autoscaler import sdk
+
+__all__ = ["sdk"]

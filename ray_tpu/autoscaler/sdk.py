@@ -1,0 +1,27 @@
+"""Autoscaler SDK (ref: python/ray/autoscaler/sdk.py).
+
+`request_resources(num_cpus=..., bundles=[...])` records an explicit demand
+with the controller, which warms worker processes up to the request (bounded
+by max_workers) so bursty task submission doesn't pay per-task spawn
+latency. A new call replaces the previous request (reference overwrite
+semantics); `request_resources()` with no arguments clears it.
+"""
+
+from typing import Dict, List, Optional
+
+from ray_tpu._private import state
+
+
+def request_resources(num_cpus: Optional[int] = None,
+                      bundles: Optional[List[Dict[str, float]]] = None) -> dict:
+    """Ask the cluster to hold capacity for `num_cpus` CPUs and/or a list of
+    resource bundles. Returns {target_cpus, fulfilled_cpus, clamped,
+    spawned_workers}; `clamped` is True when the request exceeds what this
+    host can provide (the reference would add nodes; we cannot)."""
+    return state.global_client().request_resources(num_cpus, bundles)
+
+
+def status() -> dict:
+    """Autoscaler view: last request, pool/idle worker counts, pending task
+    demand, and cluster totals (ref: `ray status` / autoscaler reporting)."""
+    return state.global_client().autoscaler_status()

@@ -22,8 +22,8 @@ import traceback
 from typing import Optional, Tuple
 
 from ray_tpu.serve.proxy import (Request, Response, _BadRequest,
-                                 _ChunkedBodyUnsupported, _coerce_response,
-                                 read_http_request, write_http_response)
+                                 _coerce_response, read_http_request,
+                                 write_http_response)
 
 DASHBOARD_ACTOR_NAME = "_rtpu_dashboard"
 DASHBOARD_NAMESPACE = "_system"
@@ -56,11 +56,6 @@ class DashboardActor:
             while True:
                 try:
                     req = await read_http_request(reader)
-                except _ChunkedBodyUnsupported:
-                    await write_http_response(writer, Response(
-                        b"chunked request bodies are not supported", 411,
-                        media_type="text/plain"))
-                    break
                 except _BadRequest as e:
                     await write_http_response(writer, Response(
                         str(e).encode(), 400, media_type="text/plain"))

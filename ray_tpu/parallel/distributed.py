@@ -66,9 +66,15 @@ def is_multihost() -> bool:
 
 
 def barrier(name: str = "barrier"):
-    """Cross-host sync: a tiny psum over all devices forces a global
-    rendezvous (reference: ray.util.collective barrier over NCCL)."""
+    """Cross-host sync over ALL processes' devices (reference:
+    ray.util.collective barrier over NCCL). multihost_utils routes the
+    rendezvous through the distributed runtime, so it genuinely blocks until
+    every process arrives — a local-device psum would not."""
     import jax
-    import jax.numpy as jnp
-    jax.device_get(jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
-        jnp.ones((jax.local_device_count(),))))
+    from jax.experimental import multihost_utils
+    if jax.process_count() > 1:
+        multihost_utils.sync_global_devices(name)
+    else:
+        import jax.numpy as jnp
+        jax.device_get(jax.pmap(lambda x: jax.lax.psum(x, "i"), axis_name="i")(
+            jnp.ones((jax.local_device_count(),))))

@@ -107,7 +107,10 @@ class RemoteFunction:
             retry_exceptions=bool(opts.get("retry_exceptions", False)),
             name=opts.get("name") or self.__name__,
             scheduling_strategy=opts.get("scheduling_strategy"),
-            runtime_env=opts.get("runtime_env"),
+            # per-submission copy: the env key is memoized into this dict at
+            # schedule time; sharing the user's dict would freeze the first
+            # submission's content snapshot across later edited resubmits
+            runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,
             job_id=client.job_id,
         )
         _apply_scheduling_strategy(spec, opts.get("scheduling_strategy"))

@@ -143,7 +143,10 @@ class CQL(Algorithm):
                 shape = (b, n_act)
                 q_rand = mod.critic.apply(qp, obs_rep, a_rand).reshape(shape)
                 q_pi = mod.critic.apply(qp, obs_rep, a_pi).reshape(shape)
-                q_pin = mod.critic.apply(qp, nxt_rep, a_pin).reshape(shape)
+                # CQL(H): actions sampled from pi(.|s') are still scored at
+                # the CURRENT state — all logsumexp terms estimate
+                # logsumexp_a Q(s, a) (ref: rllib cql cql_torch_policy)
+                q_pin = mod.critic.apply(qp, obs_rep, a_pin).reshape(shape)
                 cat = jnp.concatenate([
                     q_rand - log_u,
                     q_pi - logp_pi.reshape(shape),
@@ -202,16 +205,20 @@ class CQL(Algorithm):
     def training_step(self) -> Dict:
         cfg = self.config
         last = {}
+        lr_used = float(self._lr_schedule(self._updates))
         for i in range(cfg.train_intensity):
             idx = self._rng.integers(0, self._n, size=cfg.train_batch_size)
             mb = {k: v[idx] for k, v in self._data.items()}
             key = jax.random.PRNGKey(cfg.seed * 100_003 + self._updates)
             bc_phase = self._updates < cfg.bc_iters
+            # lr of the update being applied (schedule is evaluated at the
+            # pre-increment count, same convention as JaxLearner)
+            lr_used = float(self._lr_schedule(self._updates))
             self.weights, self.opt_state, last = self._update(
                 self.weights, self.opt_state, mb, key, bc_phase)
             self._updates += 1
         learner = {k: float(v) for k, v in jax.device_get(last).items()}
-        learner["cur_lr"] = float(self._lr_schedule(self._updates))
+        learner["cur_lr"] = lr_used
         return {"learner": learner, "num_env_steps_sampled_this_iter": 0}
 
     # -------------------------------------------------------------- eval/util

@@ -70,17 +70,38 @@ _STATUS_TEXT = {200: "OK", 204: "No Content", 400: "Bad Request",
                 503: "Service Unavailable"}
 
 
-class _ChunkedBodyUnsupported(Exception):
-    pass
-
-
 class _BadRequest(Exception):
     pass
 
 
+async def _read_chunked_body(reader) -> bytes:
+    """Decode a Transfer-Encoding: chunked body (size-hex CRLF data CRLF ...
+     0 CRLF trailers CRLF). Ref contrast: the reference proxy gets this for
+    free from uvicorn's h11; here the decoder is explicit."""
+    chunks = []
+    while True:
+        size_line = await reader.readline()
+        if not size_line:
+            raise _BadRequest("truncated chunked body")
+        try:
+            size = int(size_line.split(b";", 1)[0].strip(), 16)
+        except ValueError:
+            raise _BadRequest("invalid chunk size") from None
+        if size == 0:
+            break
+        chunks.append(await reader.readexactly(size))
+        if await reader.readexactly(2) != b"\r\n":
+            raise _BadRequest("malformed chunk terminator")
+    while True:  # trailers, if any, end with a blank line
+        tline = await reader.readline()
+        if tline in (b"\r\n", b"\n", b""):
+            break
+    return b"".join(chunks)
+
+
 async def read_http_request(reader) -> Optional[Request]:
-    """Parse one HTTP/1.1 request (request line, headers, Content-Length
-    body). Shared by the serve proxy and the dashboard server."""
+    """Parse one HTTP/1.1 request (request line, headers, Content-Length or
+    chunked body). Shared by the serve proxy and the dashboard server."""
     line = await reader.readline()
     if not line or line in (b"\r\n", b"\n"):
         return None
@@ -97,9 +118,10 @@ async def read_http_request(reader) -> Optional[Request]:
             k, v = hline.decode("latin1").split(":", 1)
             headers[k.strip().lower()] = v.strip()
     if "chunked" in headers.get("transfer-encoding", "").lower():
-        # not supported; reading it as a request line would desync the
-        # connection — surface 411 and close (handled by caller)
-        raise _ChunkedBodyUnsupported()
+        body = await _read_chunked_body(reader)
+        parts = urlsplit(target)
+        return Request(method.upper(), unquote(parts.path), parts.query,
+                       headers, body)
     try:
         length = int(headers.get("content-length", 0) or 0)
         if length < 0:
@@ -246,11 +268,6 @@ class ProxyActor:
     async def _serve_one(self, reader, writer) -> bool:
         try:
             req = await self._read_request(reader)
-        except _ChunkedBodyUnsupported:
-            await self._write_plain(writer, Response(
-                b"chunked request bodies are not supported; send "
-                b"Content-Length", 411, media_type="text/plain"))
-            return False
         except _BadRequest as e:
             await self._write_plain(writer, Response(
                 str(e).encode(), 400, media_type="text/plain"))

@@ -1,0 +1,51 @@
+"""Autoscaler hooks (ref: python/ray/autoscaler/sdk.py request_resources):
+explicit demand warms the worker pool; requests overwrite; infeasible
+requests are clamped and reported, not silently dropped."""
+
+import time
+
+
+def test_request_resources_warms_pool(ray_session):
+    from ray_tpu.autoscaler import sdk
+
+    res = sdk.request_resources(num_cpus=3)
+    assert res["target_cpus"] == 3
+    assert res["fulfilled_cpus"] == 3
+    assert res["clamped"] is False
+    st = sdk.status()
+    assert st["pool_workers"] >= 3
+    assert st["request"]["target_cpus"] == 3
+    # warmed workers become idle and usable
+    deadline = time.time() + 30
+    while time.time() < deadline and sdk.status()["idle_workers"] < 3:
+        time.sleep(0.1)
+    assert sdk.status()["idle_workers"] >= 3
+
+    ray = ray_session
+
+    @ray.remote
+    def f(x):
+        return x * 2
+
+    assert ray.get([f.remote(i) for i in range(6)]) == [0, 2, 4, 6, 8, 10]
+    # clear the standing request (overwrite semantics)
+    res = sdk.request_resources()
+    assert res["target_cpus"] == 0
+    assert sdk.status()["request"]["target_cpus"] == 0
+
+
+def test_request_resources_clamped_to_host(ray_session):
+    from ray_tpu.autoscaler import sdk
+
+    res = sdk.request_resources(num_cpus=10_000)
+    assert res["clamped"] is True
+    assert res["fulfilled_cpus"] == sdk.status()["max_workers"]
+    sdk.request_resources()  # clear
+
+
+def test_request_resources_bundles(ray_session):
+    from ray_tpu.autoscaler import sdk
+
+    res = sdk.request_resources(bundles=[{"CPU": 1}, {"CPU": 2}])
+    assert res["target_cpus"] == 3
+    sdk.request_resources()  # clear

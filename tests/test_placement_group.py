@@ -1,0 +1,57 @@
+"""Placement groups (ref: python/ray/tests/test_placement_group.py):
+reservation accounting, bundle-scoped scheduling, strategy validation,
+single-node STRICT_SPREAD infeasibility, and in-task group capture."""
+
+import pytest
+
+from ray_tpu import util as rt_util
+from ray_tpu.util import (PlacementGroupSchedulingStrategy,
+                          get_current_placement_group, placement_group,
+                          remove_placement_group)
+
+
+def test_reserve_schedule_and_release(ray_session):
+    ray = ray_session
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="PACK")
+    assert pg.wait(10)
+    assert ray.get(pg.ready())
+    assert pg.bundle_specs == [{"CPU": 1}, {"CPU": 1}]
+
+    @ray.remote
+    def where():
+        cur = get_current_placement_group()
+        return None if cur is None else (cur.id, cur.strategy, cur.bundles)
+
+    got = ray.get(where.options(scheduling_strategy=PlacementGroupSchedulingStrategy(
+        placement_group=pg, placement_group_bundle_index=1)).remote())
+    assert got == (pg.id, "PACK", [{"CPU": 1}, {"CPU": 1}])
+    # outside any group: None
+    assert ray.get(where.remote()) is None
+
+    # the reservation is carved out of the cluster pool and returned on remove
+    total, avail_with_pg = ray.cluster_resources(), ray.available_resources()
+    remove_placement_group(pg)
+    import time
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            ray.available_resources().get("CPU", 0) <= avail_with_pg.get("CPU", 0):
+        time.sleep(0.05)
+    assert ray.available_resources()["CPU"] == avail_with_pg["CPU"] + 2
+
+
+def test_invalid_strategy_rejected(ray_session):
+    with pytest.raises(ValueError, match="Invalid placement strategy"):
+        placement_group([{"CPU": 1}], strategy="SCATTER")
+
+
+def test_strict_spread_infeasible_on_one_node(ray_session):
+    with pytest.raises(ValueError, match="STRICT_SPREAD"):
+        placement_group([{"CPU": 1}, {"CPU": 1}], strategy="STRICT_SPREAD")
+    # one bundle on one node is satisfiable
+    pg = placement_group([{"CPU": 1}], strategy="STRICT_SPREAD")
+    remove_placement_group(pg)
+
+
+def test_spread_accepted_single_node(ray_session):
+    pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="SPREAD")
+    remove_placement_group(pg)

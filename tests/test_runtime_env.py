@@ -1,0 +1,163 @@
+"""runtime_env: env_vars / py_modules / working_dir / pip venv isolation.
+
+Model: python/ray/tests/test_runtime_env.py + runtime_env/pip.py semantics —
+a task or actor declares its environment and the cluster builds it (cached by
+content hash) before dispatching work to a worker constructed for it.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+import ray_tpu
+from ray_tpu.exceptions import RuntimeEnvSetupError
+
+
+def test_env_vars_applied_and_isolated(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def read_env():
+        return os.environ.get("RT_TEST_VAR")
+
+    # default env: variable absent
+    assert ray.get(read_env.remote()) is None
+    # runtime_env worker: variable present
+    val = ray.get(read_env.options(
+        runtime_env={"env_vars": {"RT_TEST_VAR": "hello"}}).remote())
+    assert val == "hello"
+    # and the default-env worker pool stays clean afterwards
+    assert ray.get(read_env.remote()) is None
+
+
+def test_py_modules_injected(ray_session, tmp_path):
+    ray = ray_session
+    mod = tmp_path / "rtenv_mod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("MAGIC = 1234\n")
+
+    @ray.remote
+    def use_module():
+        import rtenv_mod
+        return rtenv_mod.MAGIC
+
+    with pytest.raises(Exception):
+        # not importable without the runtime_env
+        ray.get(use_module.remote())
+    got = ray.get(use_module.options(
+        runtime_env={"py_modules": [str(mod)]}).remote())
+    assert got == 1234
+
+
+def test_working_dir_staged_and_cwd(ray_session, tmp_path):
+    ray = ray_session
+    wd = tmp_path / "proj"
+    wd.mkdir()
+    (wd / "data.txt").write_text("payload-42")
+
+    @ray.remote
+    def read_rel():
+        with open("data.txt") as f:
+            return f.read()
+
+    got = ray.get(read_rel.options(
+        runtime_env={"working_dir": str(wd)}).remote())
+    assert got == "payload-42"
+
+
+def test_actor_runtime_env(ray_session, tmp_path):
+    ray = ray_session
+    mod = tmp_path / "rtenv_actor_mod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("WHO = 'actor-env'\n")
+
+    @ray.remote
+    class EnvActor:
+        def who(self):
+            import rtenv_actor_mod
+            return (rtenv_actor_mod.WHO, os.environ.get("RT_ACTOR_VAR"))
+
+    a = EnvActor.options(runtime_env={
+        "py_modules": [str(mod)],
+        "env_vars": {"RT_ACTOR_VAR": "set"},
+    }).remote()
+    assert ray.get(a.who.remote()) == ("actor-env", "set")
+    ray.kill(a)
+
+
+def test_bad_py_modules_fails_task(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def f():
+        return 1
+
+    ref = f.options(
+        runtime_env={"py_modules": ["/nonexistent/path/xyz"]}).remote()
+    with pytest.raises(RuntimeEnvSetupError):
+        ray.get(ref, timeout=30)
+
+
+def test_unsupported_key_fails_task(ray_session):
+    ray = ray_session
+
+    @ray.remote
+    def f():
+        return 1
+
+    ref = f.options(runtime_env={"conda": {"name": "nope"}}).remote()
+    with pytest.raises(RuntimeEnvSetupError):
+        ray.get(ref, timeout=30)
+
+
+def test_pip_venv_local_package(ray_session, tmp_path):
+    """Offline pip: install a local package into the per-env venv and import
+    it from a task (no network: --no-index --no-build-isolation)."""
+    ray = ray_session
+    pkg = tmp_path / "rtenvpip"
+    pkg.mkdir()
+    (pkg / "pyproject.toml").write_text(textwrap.dedent("""\
+        [build-system]
+        requires = ["setuptools"]
+        build-backend = "setuptools.build_meta"
+        [project]
+        name = "rtenv-pip-pkg"
+        version = "0.0.1"
+        [tool.setuptools]
+        py-modules = ["rtenv_pip_mod"]
+    """))
+    (pkg / "rtenv_pip_mod.py").write_text("ANSWER = 4242\n")
+
+    @ray.remote
+    def use_pkg():
+        import rtenv_pip_mod
+        return rtenv_pip_mod.ANSWER, sys.prefix
+
+    ans, prefix = ray.get(use_pkg.options(runtime_env={
+        "pip": {"packages": [str(pkg)],
+                "pip_install_options": ["--no-index", "--no-build-isolation"]},
+    }).remote(), timeout=180)
+    assert ans == 4242
+    # the task really ran under the per-env venv interpreter
+    assert "ray_tpu_runtime_envs" in prefix
+
+
+def test_edited_py_module_restaged_on_resubmit(ray_session, tmp_path):
+    """Editing user code then resubmitting with the SAME runtime_env dict
+    must pick up the new content (stat digest folds into the env key)."""
+    ray = ray_session
+    mod = tmp_path / "rtenv_edit_mod"
+    mod.mkdir()
+    (mod / "__init__.py").write_text("V = 1\n")
+
+    @ray.remote
+    def read_v():
+        import rtenv_edit_mod
+        return rtenv_edit_mod.V
+
+    renv = {"py_modules": [str(mod)]}  # reused dict, like real user code
+    assert ray.get(read_v.options(runtime_env=renv).remote()) == 1
+    (mod / "__init__.py").write_text("V = 2\n")
+    assert ray.get(read_v.options(runtime_env=renv).remote()) == 2

@@ -1,0 +1,57 @@
+"""QuasiBayesSearch validation (VERDICT r2 weak #6): the explore/exploit
+sampler must actually beat pure random search on a smooth surrogate — not
+just carry the name. Deterministic: fixed seeds, averaged over repeats."""
+
+import numpy as np
+
+from ray_tpu.tune.search import BasicVariantGenerator, QuasiBayesSearch
+from ray_tpu.tune.search_space import Uniform
+
+
+def _surrogate(cfg):
+    # smooth unimodal bowl with optimum at (0.31, 0.73); scale > jitter noise
+    return -((cfg["x"] - 0.31) ** 2 + (cfg["y"] - 0.73) ** 2)
+
+
+def _run(searcher, budget):
+    best = -np.inf
+    for i in range(budget):
+        cfg = searcher.suggest(f"t{i}")
+        if cfg is None:
+            break
+        score = _surrogate(cfg)
+        searcher.on_trial_complete(f"t{i}", {"score": score})
+        best = max(best, score)
+    return best
+
+
+def test_quasibayes_beats_random_on_surrogate():
+    space = {"x": Uniform(0.0, 1.0), "y": Uniform(0.0, 1.0)}
+    budget, seeds = 32, range(12)
+    qb_scores, rnd_scores = [], []
+    for seed in seeds:
+        qb = QuasiBayesSearch(dict(space), num_samples=budget, seed=seed,
+                              metric="score", mode="max", warmup=6)
+        qb_scores.append(_run(qb, budget))
+        rnd = BasicVariantGenerator(dict(space), num_samples=budget, seed=seed)
+        rnd_scores.append(_run(rnd, budget))
+    # exploit phase should sharpen the best-found optimum on average
+    assert np.mean(qb_scores) > np.mean(rnd_scores), (
+        f"QuasiBayesSearch {np.mean(qb_scores):.5f} did not beat random "
+        f"{np.mean(rnd_scores):.5f}")
+    # and should win (or tie within noise) on a clear majority of seeds
+    wins = sum(q >= r for q, r in zip(qb_scores, rnd_scores))
+    assert wins >= len(qb_scores) * 0.6, (qb_scores, rnd_scores)
+
+
+def test_quasibayes_handles_minimize_mode():
+    space = {"x": Uniform(0.0, 1.0)}
+    qb = QuasiBayesSearch(space, num_samples=16, seed=3,
+                          metric="loss", mode="min", warmup=4)
+    best = np.inf
+    for i in range(16):
+        cfg = qb.suggest(f"t{i}")
+        loss = (cfg["x"] - 0.5) ** 2
+        qb.on_trial_complete(f"t{i}", {"loss": loss})
+        best = min(best, loss)
+    assert best < 0.01
