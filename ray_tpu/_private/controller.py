@@ -217,6 +217,13 @@ class Controller:
         except OSError:
             pass
 
+    def _retire_idle_worker(self, w: WorkerConn):
+        """Kill an idle pool worker to make room for another runtime env.
+        Not "dead" (that's _on_worker_dead's transition when the connection
+        drops) but no longer dispatchable while the kill is in flight."""
+        self._kill_worker_proc(w)
+        w.state = "dying"
+
     def _kill_worker_proc(self, w: WorkerConn):
         if w.proc is not None and w.proc.poll() is None:
             try:
@@ -658,10 +665,7 @@ class Controller:
                         None)
                     if victim is None:
                         break
-                    self._kill_worker_proc(victim)
-                    # not "dead" (that's _on_worker_dead's transition) but no
-                    # longer dispatchable while the kill is in flight
-                    victim.state = "dying"
+                    self._retire_idle_worker(victim)
                     headroom += 1
                 try:
                     self._spawn_worker(env_key=env_key,
@@ -687,8 +691,7 @@ class Controller:
             if not self._env_ready(env_specs.get(env_key)):
                 continue
             for w in tpu_workers:
-                self._kill_worker_proc(w)
-                w.state = "dying"
+                self._retire_idle_worker(w)
             try:
                 self._spawn_worker(tpu_capable=True, env_key=env_key,
                                    runtime_env=env_specs.get(env_key))

@@ -27,6 +27,9 @@ import jax.numpy as jnp
 
 from ray_tpu.ops.attention import apply_rope, decode_attention, mha_reference
 from ray_tpu.ops.flash_attention import flash_attention
+from ray_tpu.ops.paged_attention import (PagedKVCache, paged_attention,
+                                         paged_attention_reference,
+                                         write_layer_tokens)
 from ray_tpu.ops.ring_attention import ring_attention
 
 
@@ -145,7 +148,28 @@ class Attention(nn.Module):
         k = apply_rope(k, positions, cfg.rope_theta)
 
         new_cache_kv = None
-        if cache is not None:
+        if isinstance(cache, PagedKVCache):
+            # Paged decode/prefill (vLLM memory model, ops/paged_attention):
+            # write this layer's K/V into its page slice, then attend. The
+            # cache threads through the block stack; layers touch disjoint
+            # pool slices so every scatter is in-place under donation.
+            cache = write_layer_tokens(cache, layer_idx, k, v, positions)
+            if t == 1:
+                # decode: pallas kernel walks the block table (XLA gather
+                # reference off-TPU, same numerics)
+                impl = (paged_attention if jax.default_backend() == "tpu"
+                        else paged_attention_reference)
+                out = impl(q[:, 0], cache.k_pages[layer_idx],
+                           cache.v_pages[layer_idx], cache.block_tables,
+                           positions[:, -1] + 1)[:, None]
+            else:
+                # prefill of a fresh row: nothing cached to read back, so
+                # plain causal attention over the prompt is exact
+                out = (flash_attention(q, k, v, causal=True)
+                       if jax.default_backend() == "tpu"
+                       else mha_reference(q, k, v, causal=True))
+            new_cache_kv = cache
+        elif cache is not None:
             # Decode: write current K/V at `length`, attend over the cache.
             k_cache = jax.vmap(
                 lambda c, u, i: jax.lax.dynamic_update_slice_in_dim(c, u, i, 0)
@@ -231,17 +255,22 @@ class Llama(nn.Module):
         if cfg.remat and cache is None:
             block_cls = nn.remat(
                 Block, policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable)
+        paged = isinstance(cache, PagedKVCache)
         new_k, new_v = [], []
         for i in range(cfg.n_layers):
             x, new_kv = block_cls(cfg, i, name=f"layers_{i}")(x, positions, cache)
-            if new_kv is not None:
+            if paged:
+                cache = new_kv  # thread the updated page pools layer→layer
+            elif new_kv is not None:
                 new_k.append(new_kv[0])
                 new_v.append(new_kv[1])
 
         x = RMSNorm(cfg.norm_eps, cfg.dtype, name="final_norm")(x)
         if return_hidden:
             new_cache = None
-            if cache is not None:
+            if paged:
+                new_cache = cache.replace(lengths=cache.lengths + t)
+            elif cache is not None:
                 new_cache = KVCache(k=tuple(new_k), v=tuple(new_v),
                                     length=cache.length + t)
             return x, new_cache
@@ -255,7 +284,9 @@ class Llama(nn.Module):
         logits = logits.astype(jnp.float32)
 
         new_cache = None
-        if cache is not None:
+        if paged:
+            new_cache = cache.replace(lengths=cache.lengths + t)
+        elif cache is not None:
             new_cache = KVCache(k=tuple(new_k), v=tuple(new_v),
                                 length=cache.length + t)
         return logits, new_cache

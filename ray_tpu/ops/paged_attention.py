@@ -1,0 +1,313 @@
+"""Paged attention: decode attention over a paged KV cache (pallas/TPU).
+
+Reference contrast: the reference serves LLMs by wrapping vLLM, whose paged
+attention is a CUDA kernel walking a per-sequence page table
+(vllm PagedAttention; ray serve LLM integration). The TPU-native form:
+
+- KV pages live as one pool `[Kh, P, page, D]` in HBM.
+- A block table `[B, max_pages]` maps each sequence's logical pages to pool
+  slots; `lengths[B]` counts valid tokens.
+- The kernel runs a grid `(B, Kh, max_pages)` with the block table and
+  lengths as SCALAR-PREFETCH args (pltpu.PrefetchScalarGridSpec): the
+  index_map reads `table[b, p]` to DMA exactly that page into VMEM while the
+  previous page computes — the pallas pipeline does the job of vLLM's manual
+  gather, and pages never materialize contiguously anywhere.
+- Online-softmax accumulation across pages (same recurrence as
+  ops/flash_attention.py), GQA folded as [G, D] q-blocks per kv head.
+
+Decode is HBM-bandwidth-bound: the win is that only referenced pages move,
+so fragmented long-context batches stream at full bandwidth regardless of
+slot order. `paged_attention_reference` is the XLA gather equivalent used
+for numerics tests and as the CPU fallback.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+
+
+def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_scr, l_scr, acc_scr, *, scale, page_size, max_pages,
+                   gsize):
+    """One (b, kh, p) step: fold page p of sequence b into the accumulator.
+
+    q_ref: [1, G, D] (the kv head's query group), k_ref/v_ref: [1, 1, page, D]
+    (the page the index_map DMA'd via the block table), o_ref: [1, G, D].
+    """
+    b = pl.program_id(0)
+    p = pl.program_id(2)
+    seq_len = len_ref[b]
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, -jnp.inf)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # pages past the sequence's last token carry no data; their table entry
+    # is a placeholder (0), so skip both compute and accumulator updates
+    @pl.when(p * page_size < seq_len)
+    def _fold():
+        q = q_ref[0, 0].astype(jnp.float32)                    # [G, D]
+        gp = m_scr.shape[0]
+        if gp != q.shape[0]:  # pad tiny GQA groups to the scratch height
+            q = jnp.concatenate(
+                [q, jnp.zeros((gp - q.shape[0], q.shape[1]), q.dtype)])
+        k = k_ref[0, 0].astype(jnp.float32)                    # [page, D]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale        # [Gp, page]
+        cols = p * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(cols < seq_len, s, -jnp.inf)
+
+        m_prev = m_scr[:, :1]                                  # [G, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # p==0 always holds >=1 valid token (lengths >= 1 in decode), so
+        # m_new > -inf from the first fold on and exp() stays NaN-free
+        pmat = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(pmat, axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            pmat, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(p == max_pages - 1)
+    def _finish():
+        o_ref[0, 0] = (acc_scr[:gsize] / l_scr[:gsize, :1]).astype(o_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,             # [B, H, D] — one decode token per sequence
+    k_pages: jax.Array,       # [Kh, P, page, D] — global page pool
+    v_pages: jax.Array,       # [Kh, P, page, D]
+    block_tables: jax.Array,  # [B, max_pages] int32 — pool slot per page
+    lengths: jax.Array,       # [B] int32 — valid tokens per sequence (>= 1)
+    *,
+    scale: Optional[float] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Paged decode attention; returns [B, H, D].
+
+    Unused table entries must be valid pool indices (0 is fine) — they are
+    DMA'd but masked out. Sequences attend to their first `lengths` tokens.
+    """
+    b, h, d = q.shape
+    kh, _pool, page_size, _d = k_pages.shape
+    g = h // kh
+    max_pages = block_tables.shape[1]
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+
+    grid = (b, kh, max_pages)
+    kernel = functools.partial(
+        _decode_kernel, scale=scale, page_size=page_size,
+        max_pages=max_pages, gsize=g)
+    q3 = q.reshape(b, kh, g, d)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, g, d),
+                             lambda b_, kh_, p_, tbl, lens: (b_, kh_, 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda b_, kh_, p_, tbl, lens:
+                             (kh_, tbl[b_, p_], 0, 0)),
+                pl.BlockSpec((1, 1, page_size, d),
+                             lambda b_, kh_, p_, tbl, lens:
+                             (kh_, tbl[b_, p_], 0, 0)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 1, g, d), lambda b_, kh_, p_, tbl, lens: (b_, kh_, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((max(g, 8), _LANES), jnp.float32),
+                pltpu.VMEM((max(g, 8), _LANES), jnp.float32),
+                pltpu.VMEM((max(g, 8), d), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kh, g, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, lengths, q3, k_pages, v_pages)
+    return out.reshape(b, h, d)
+
+
+def paged_attention_reference(q, k_pages, v_pages, block_tables, lengths,
+                              *, scale: Optional[float] = None) -> jax.Array:
+    """XLA equivalent (gather pages → masked attention): numerics oracle for
+    the kernel and the CPU-backend fallback."""
+    b, h, d = q.shape
+    kh, _pool, page_size, _d = k_pages.shape
+    g = h // kh
+    scale = scale if scale is not None else 1.0 / math.sqrt(d)
+    # [B, Kh, max_pages, page, D] → [B, Kh, S, D]
+    k_seq = jnp.swapaxes(k_pages[:, block_tables], 0, 1)
+    v_seq = jnp.swapaxes(v_pages[:, block_tables], 0, 1)
+    s_max = block_tables.shape[1] * page_size
+    k_seq = k_seq.reshape(b, kh, s_max, d)
+    v_seq = v_seq.reshape(b, kh, s_max, d)
+    qg = q.reshape(b, kh, g, d).astype(jnp.float32)
+    s = jnp.einsum("bkgd,bksd->bkgs", qg, k_seq.astype(jnp.float32)) * scale
+    mask = jnp.arange(s_max)[None, None, None, :] < lengths[:, None, None, None]
+    s = jnp.where(mask, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bkgs,bksd->bkgd", p, v_seq.astype(jnp.float32))
+    return out.reshape(b, h, d).astype(q.dtype)
+
+
+# ---------------------------------------------------------------------------
+# Paged KV cache: page pool + per-sequence block tables (vLLM's PagedAttention
+# memory model, jax-functional — the pool/table are pytree leaves updated
+# with pure scatters inside jit; page allocation is host-side bookkeeping).
+# ---------------------------------------------------------------------------
+
+import flax.struct
+
+
+class PagedKVCache(flax.struct.PyTreeNode):
+    """Per-layer page pools and shared block tables.
+
+    k_pages/v_pages: [L, Kh, P, page, D]; block_tables: [B, max_pages];
+    lengths: [B]. Rows whose slot is free have length 0 and table entries 0.
+    """
+    k_pages: jax.Array
+    v_pages: jax.Array
+    block_tables: jax.Array
+    lengths: jax.Array
+
+    @property
+    def page_size(self):
+        return self.k_pages.shape[3]
+
+    @property
+    def length(self):
+        """Alias matching KVCache.length so the decoder's position math is
+        cache-type agnostic."""
+        return self.lengths
+
+    @staticmethod
+    def init(n_layers: int, n_kv_heads: int, head_dim: int, num_pages: int,
+             page_size: int, batch_slots: int, max_pages_per_seq: int,
+             dtype=jnp.bfloat16) -> "PagedKVCache":
+        shape = (n_layers, n_kv_heads, num_pages, page_size, head_dim)
+        return PagedKVCache(
+            k_pages=jnp.zeros(shape, dtype),
+            v_pages=jnp.zeros(shape, dtype),
+            block_tables=jnp.zeros((batch_slots, max_pages_per_seq), jnp.int32),
+            lengths=jnp.zeros((batch_slots,), jnp.int32))
+
+
+def write_tokens(cache: PagedKVCache, k_new: jax.Array, v_new: jax.Array,
+                 positions: jax.Array) -> PagedKVCache:
+    """Scatter new tokens into their pages (jit-safe pure update).
+
+    k_new/v_new: [L, B, T, Kh, D] (T tokens per row this step; T=1 decode,
+    T=prompt_len prefill). positions: [B, T] absolute token positions; the
+    caller's block table must already map position//page_size for every row.
+    Does NOT advance `lengths` — the caller owns admission bookkeeping.
+    """
+    l, bsz, t, kh, d = k_new.shape
+    pos = positions.reshape(-1)                                  # [B*T]
+    rows = jnp.repeat(jnp.arange(bsz), t)                        # [B*T]
+    page_ids = cache.block_tables[rows, pos // cache.page_size]  # [B*T]
+    offs = pos % cache.page_size
+    # [L, B, T, Kh, D] → [L, Kh, B*T, D] to line up with pool indexing
+    kv = lambda x: x.reshape(l, bsz * t, kh, d).swapaxes(1, 2)
+    k_pages = cache.k_pages.at[:, :, page_ids, offs].set(kv(k_new))
+    v_pages = cache.v_pages.at[:, :, page_ids, offs].set(kv(v_new))
+    return cache.replace(k_pages=k_pages, v_pages=v_pages)
+
+
+def write_layer_tokens(cache: PagedKVCache, layer_idx: int, k_new: jax.Array,
+                       v_new: jax.Array, positions: jax.Array) -> PagedKVCache:
+    """Scatter ONE layer's new K/V into its page slice (jit-safe).
+
+    k_new/v_new: [B, T, Kh, D]; positions: [B, T]. Layers touch disjoint
+    pool slices, so the decoder threads the cache through its blocks and
+    each scatter lowers to an in-place update under donation.
+    """
+    bsz, t, kh, d = k_new.shape
+    pos = positions.reshape(-1)
+    rows = jnp.repeat(jnp.arange(bsz), t)
+    page_ids = cache.block_tables[rows, pos // cache.page_size]
+    offs = pos % cache.page_size
+    # index tuple (scalar, :, ids, offs): the advanced indices are separated
+    # by a slice, so numpy/jax moves the broadcast dim FIRST → values must be
+    # [B*T, Kh, D] (contrast write_tokens, whose adjacent indices keep order)
+    kv = lambda x: x.reshape(bsz * t, kh, d)
+    return cache.replace(
+        k_pages=cache.k_pages.at[layer_idx, :, page_ids, offs].set(kv(k_new)),
+        v_pages=cache.v_pages.at[layer_idx, :, page_ids, offs].set(kv(v_new)))
+
+
+class PageManager:
+    """Host-side page allocator (free list + per-slot table bookkeeping).
+
+    Mirrors vLLM's BlockSpaceManager at single-host scope: admission asks
+    `can_fit(n_tokens)`, `allocate(slot, n_tokens)` assigns pool pages and
+    returns the table row, `extend(slot)` grabs the next page when a decode
+    crosses a page boundary, `free(slot)` returns pages to the pool.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, batch_slots: int,
+                 max_pages_per_seq: int):
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.max_pages_per_seq = max_pages_per_seq
+        # page 0 is reserved as the masked placeholder for unused table slots
+        self.free_pages = list(range(num_pages - 1, 0, -1))
+        self.tables = [[] for _ in range(batch_slots)]
+
+    def can_fit(self, n_tokens: int) -> bool:
+        need = -(-n_tokens // self.page_size)
+        return need <= len(self.free_pages) and need <= self.max_pages_per_seq
+
+    def allocate(self, slot: int, n_tokens: int):
+        need = -(-n_tokens // self.page_size)
+        if need > len(self.free_pages):
+            raise MemoryError(
+                f"paged KV pool exhausted: need {need} pages, "
+                f"{len(self.free_pages)} free")
+        if need > self.max_pages_per_seq:
+            raise ValueError(
+                f"sequence needs {need} pages > max_pages_per_seq "
+                f"{self.max_pages_per_seq}")
+        assert not self.tables[slot], f"slot {slot} already allocated"
+        pages = [self.free_pages.pop() for _ in range(need)]
+        self.tables[slot] = pages
+        return self.table_row(slot)
+
+    def extend(self, slot: int, new_len: int):
+        """Ensure the slot's table covers new_len tokens; returns the row."""
+        need = -(-new_len // self.page_size)
+        while len(self.tables[slot]) < need:
+            if not self.free_pages:
+                raise MemoryError("paged KV pool exhausted during decode")
+            if len(self.tables[slot]) >= self.max_pages_per_seq:
+                raise ValueError("sequence exceeded max_pages_per_seq")
+            self.tables[slot].append(self.free_pages.pop())
+        return self.table_row(slot)
+
+    def free(self, slot: int):
+        self.free_pages.extend(reversed(self.tables[slot]))
+        self.tables[slot] = []
+
+    def table_row(self, slot: int):
+        row = self.tables[slot]
+        return row + [0] * (self.max_pages_per_seq - len(row))
+
+    @property
+    def pages_in_use(self) -> int:
+        return (self.num_pages - 1) - len(self.free_pages)

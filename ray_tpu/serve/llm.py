@@ -30,7 +30,14 @@ class LLMConfig:
     temperature: float = 0.0        # 0 → greedy
     top_k: int = 0                  # 0 → full softmax
     param_dtype: str = "bfloat16"
+    dtype: Optional[str] = None     # activation dtype override (None = preset)
     seed: int = 0
+    # paged KV cache (ops/paged_attention: pallas kernel over a block table;
+    # vLLM's memory model). HBM for KV = num_pages·page_size instead of
+    # B·max_seq_len, admission reserves prompt+max_tokens pages per request.
+    paged: bool = False
+    page_size: int = 16
+    num_pages: Optional[int] = None  # default: full (B·ceil(Smax/page)) + 1
 
 
 @dataclasses.dataclass
@@ -59,8 +66,11 @@ class LLMServer:
 
         self.config = cfg = config or LLMConfig()
         preset = getattr(LlamaConfig, cfg.preset)
-        self.model_cfg = preset(max_seq_len=cfg.max_seq_len,
-                                param_dtype=getattr(jnp, cfg.param_dtype))
+        overrides = dict(max_seq_len=cfg.max_seq_len,
+                         param_dtype=getattr(jnp, cfg.param_dtype))
+        if cfg.dtype is not None:
+            overrides["dtype"] = getattr(jnp, cfg.dtype)
+        self.model_cfg = preset(**overrides)
         self.model = Llama(self.model_cfg)
         B = cfg.max_batch_slots
         key = jax.random.PRNGKey(cfg.seed)
@@ -68,7 +78,18 @@ class LLMServer:
             params = self.model.init(
                 key, jnp.zeros((1, 8), jnp.int32))
         self.params = jax.device_put(params)
-        self.cache = KVCache.init(self.model_cfg, B, cfg.max_seq_len)
+        if cfg.paged:
+            from ray_tpu.ops.paged_attention import PagedKVCache, PageManager
+            mc = self.model_cfg
+            max_pages = -(-cfg.max_seq_len // cfg.page_size)
+            num_pages = cfg.num_pages or (B * max_pages + 1)
+            self.page_mgr = PageManager(num_pages, cfg.page_size, B, max_pages)
+            self.cache = PagedKVCache.init(
+                mc.n_layers, mc.n_kv_heads, mc.head_dim, num_pages,
+                cfg.page_size, B, max_pages, dtype=mc.dtype)
+        else:
+            self.page_mgr = None
+            self.cache = KVCache.init(self.model_cfg, B, cfg.max_seq_len)
         self._active: Dict[int, _Slot] = {}   # slot idx -> request state
         self._free = list(range(B))
         self._req_counter = 0
@@ -84,6 +105,35 @@ class LLMServer:
 
         cfg = self.config
         model = self.model
+
+        def sample(logits, key):
+            """Greedy / temperature / top-k next-token choice. logits [B, V]."""
+            if cfg.temperature > 0:
+                scaled = logits / cfg.temperature
+                if cfg.top_k > 0:
+                    kth = jnp.sort(scaled, axis=-1)[:, -cfg.top_k][:, None]
+                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+                return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        def prefill_paged(params, cache, tokens, slot, true_len):
+            """Paged prefill: the row's table was set at admission; run the
+            prompt through the model (writes pages in-place) and record the
+            row's true length."""
+            row_tables = jax.lax.dynamic_slice_in_dim(cache.block_tables, slot, 1, 0)
+            row_view = cache.replace(block_tables=row_tables,
+                                     lengths=jnp.zeros((1,), jnp.int32))
+            logits, new_row = model.apply(params, tokens, cache=row_view)
+            new_cache = cache.replace(
+                k_pages=new_row.k_pages, v_pages=new_row.v_pages,
+                lengths=cache.lengths.at[slot].set(true_len))
+            return new_cache, logits[0, true_len - 1]
+
+        def decode_paged(params, cache, last_tokens, active_mask, key):
+            logits, new_cache = model.apply(params, last_tokens, cache=cache)
+            nxt = sample(logits[:, -1, :], key)
+            lengths = jnp.where(active_mask, new_cache.lengths, cache.lengths)
+            return new_cache.replace(lengths=lengths), nxt
 
         def prefill_row(params, cache, tokens, slot, true_len):
             """Write a (padded) prompt's KV into `slot`'s row; return next
@@ -107,23 +157,20 @@ class LLMServer:
         def decode_step(params, cache, last_tokens, active_mask, key):
             """One token for every slot: [B, 1] forward + sample."""
             logits, new_cache = model.apply(params, last_tokens, cache=cache)
-            logits = logits[:, -1, :]  # [B, V]
-            if cfg.temperature > 0:
-                scaled = logits / cfg.temperature
-                if cfg.top_k > 0:
-                    kth = jnp.sort(scaled, axis=-1)[:, -cfg.top_k][:, None]
-                    scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-                nxt = jax.random.categorical(key, scaled, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1)
+            nxt = sample(logits[:, -1, :], key)
             # inactive slots must not advance their cache row
             length = jnp.where(active_mask, new_cache.length, cache.length)
             new_cache = KVCache(k=new_cache.k, v=new_cache.v, length=length)
-            return new_cache, nxt.astype(jnp.int32)
+            return new_cache, nxt
 
-        self._prefill = jax.jit(prefill_row, donate_argnums=(1,),
-                                static_argnums=())
-        self._decode = jax.jit(decode_step, donate_argnums=(1,))
+        if cfg.paged:
+            self._prefill = jax.jit(prefill_paged, donate_argnums=(1,))
+            self._decode = jax.jit(decode_paged, donate_argnums=(1,))
+        else:
+            self._prefill = jax.jit(prefill_row, donate_argnums=(1,))
+            self._decode = jax.jit(decode_step, donate_argnums=(1,))
+        # first token goes through the SAME sampling policy as later ones
+        self._sample_first = jax.jit(lambda logits, key: sample(logits[None], key)[0])
 
     def _bucket(self, n: int) -> int:
         """Pad prompt lengths to power-of-two buckets: few compiled prefill
@@ -139,22 +186,40 @@ class LLMServer:
                      eos_id: Optional[int], stream: bool) -> _Slot:
         import jax.numpy as jnp
 
-        while not self._free:
-            await asyncio.sleep(0.005)
-        slot_idx = self._free.pop()
-        self._req_counter += 1
         P = len(prompt_ids)
         if P + max_tokens > self.config.max_seq_len:
-            self._free.append(slot_idx)
             raise ValueError(
                 f"prompt({P}) + max_tokens({max_tokens}) exceeds "
                 f"max_seq_len({self.config.max_seq_len})")
+        mgr = self.page_mgr
+        if mgr is not None:
+            need = -(-(P + max_tokens) // mgr.page_size)
+            if need > min(mgr.num_pages - 1, mgr.max_pages_per_seq):
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool can never "
+                    f"hold more than {min(mgr.num_pages - 1, mgr.max_pages_per_seq)} "
+                    f"per sequence (num_pages={mgr.num_pages}, "
+                    f"page_size={mgr.page_size})")
+        while not self._free or (mgr is not None
+                                 and not mgr.can_fit(P + max_tokens)):
+            # a free slot AND enough free pages (vLLM-style admission:
+            # reserve the full request up front, so decode never OOMs)
+            await asyncio.sleep(0.005)
+        slot_idx = self._free.pop()
+        self._req_counter += 1
+        if mgr is not None:
+            row = mgr.allocate(slot_idx, P + max_tokens)
+            self.cache = self.cache.replace(
+                block_tables=self.cache.block_tables.at[slot_idx].set(
+                    jnp.asarray(row, jnp.int32)))
         bucket = self._bucket(P)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :P] = prompt_ids
         self.cache, last_logits = self._prefill(
             self.params, self.cache, jnp.asarray(padded), slot_idx, P)
-        first = int(np.argmax(np.asarray(last_logits)))
+        import jax
+        self._sample_key, sub = jax.random.split(self._sample_key)
+        first = int(self._sample_first(last_logits, sub))
         slot = _Slot(request_id=self._req_counter, prompt_len=P,
                      max_tokens=max_tokens, generated=[first],
                      done_event=asyncio.Event(),
@@ -180,9 +245,20 @@ class LLMServer:
                 slot.done_event.set()
                 if slot.stream_queue is not None:
                     slot.stream_queue.put_nowait(None)
-                self._free.append(i)
+                self._release_slot(i)
             self._active.clear()
             raise
+
+    def _release_slot(self, i: int):
+        """Return slot i to the pool; paged mode also frees its pages and
+        zeroes its table row so inactive-slot decode writes land on the
+        reserved placeholder page, never on another request's pages."""
+        if self.page_mgr is not None:
+            self.page_mgr.free(i)
+            self.cache = self.cache.replace(
+                block_tables=self.cache.block_tables.at[i].set(0),
+                lengths=self.cache.lengths.at[i].set(0))
+        self._free.append(i)
 
     async def _tick_loop_inner(self):
         """The continuous-batching engine: one decode step per iteration
@@ -218,7 +294,7 @@ class LLMServer:
                 slot.done_event.set()
                 if slot.stream_queue is not None:
                     slot.stream_queue.put_nowait(None)
-                self._free.append(i)
+                self._release_slot(i)
             await asyncio.sleep(0)  # let admits interleave between ticks
 
     # -- public api ----------------------------------------------------------
@@ -251,5 +327,9 @@ class LLMServer:
             raise RuntimeError("decode engine failed") from slot.error
 
     def stats(self) -> Dict[str, int]:
-        return {"active": len(self._active), "free_slots": len(self._free),
-                "requests": self._req_counter}
+        s = {"active": len(self._active), "free_slots": len(self._free),
+             "requests": self._req_counter}
+        if self.page_mgr is not None:
+            s["pages_in_use"] = self.page_mgr.pages_in_use
+            s["pages_free"] = len(self.page_mgr.free_pages)
+        return s
