@@ -86,6 +86,9 @@ class DashboardActor:
         path = req.path.rstrip("/") or "/"
         client = _state.global_client()
 
+        if path == "/":
+            return Response(_INDEX_HTML.encode(), 200,
+                            media_type="text/html; charset=utf-8")
         if path == "/api/version":
             return _coerce_response({
                 "ray_tpu_version": "0.3", "session": client.job_id})
@@ -96,8 +99,23 @@ class DashboardActor:
                 "total_resources": total, "available_resources": avail,
                 "nodes": nodes})
         if path in ("/api/nodes", "/api/actors", "/api/tasks", "/api/objects",
-                    "/api/workers"):
+                    "/api/workers", "/api/placement_groups"):
             return _coerce_response(client.state(path.rsplit("/", 1)[-1]))
+        if path == "/api/autoscaler":
+            return _coerce_response(client.autoscaler_status())
+        if path == "/api/metrics":
+            # this process's util.metrics registry (registries are
+            # per-process, like the reference's worker-local metric agents)
+            from ray_tpu.util.metrics import collect
+            return _coerce_response(collect())
+        if path == "/metrics":
+            # Prometheus scrape endpoint: cluster-level gauges synthesized
+            # from the controller state plus this process's registry (ref:
+            # ray's metrics agent exporting over an HTTP scrape port)
+            from ray_tpu.util.metrics import collect
+            snaps = _cluster_snapshots(client) + collect()
+            return Response(_prometheus_text(snaps).encode(), 200,
+                            media_type="text/plain; version=0.0.4")
 
         if path == "/api/jobs":
             loop = asyncio.get_running_loop()
@@ -154,6 +172,120 @@ class DashboardActor:
 
     def stats(self):
         return {"host": self._host, "port": self._port}
+
+
+# Single-file web UI (ref: ray's dashboard SPA — ours is one page over the
+# same /api endpoints the CLI uses; no build step, no bundled JS framework).
+_INDEX_HTML = """<!doctype html>
+<html><head><meta charset="utf-8"><title>ray_tpu dashboard</title>
+<style>
+ body{font-family:ui-monospace,Menlo,monospace;margin:2rem;background:#111;color:#ddd}
+ h1{font-size:1.2rem} h2{font-size:1rem;margin:1.2rem 0 .4rem;color:#9cf}
+ table{border-collapse:collapse;width:100%;font-size:.85rem}
+ td,th{border:1px solid #333;padding:.25rem .5rem;text-align:left}
+ th{background:#1c1c1c;color:#9cf} .num{text-align:right}
+ #err{color:#f66}
+</style></head><body>
+<h1>ray_tpu dashboard <span id="session"></span></h1><div id="err"></div>
+<h2>cluster</h2><table id="cluster"></table>
+<h2>autoscaler</h2><table id="auto"></table>
+<h2>actors</h2><table id="actors"></table>
+<h2>jobs</h2><table id="jobs"></table>
+<h2>recent tasks</h2><table id="tasks"></table>
+<script>
+const J = p => fetch(p).then(r => r.json());
+// API strings (names, entrypoints) are user-controlled: escape before innerHTML
+const esc = s => String(s).replace(/[&<>"']/g,
+  ch => ({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
+const row = cs => "<tr>" + cs.map(c => `<td>${esc(c)}</td>`).join("") + "</tr>";
+const head = cs => "<tr>" + cs.map(c => `<th>${esc(c)}</th>`).join("") + "</tr>";
+async function refresh(){
+ try{
+  const v = await J("/api/version");
+  document.getElementById("session").textContent = "(session " + v.session + ")";
+  const s = await J("/api/cluster_status");
+  document.getElementById("cluster").innerHTML = head(["resource","total","available"]) +
+   Object.keys(s.total_resources).map(k =>
+     row([k, s.total_resources[k], s.available_resources[k] ?? 0])).join("");
+  const a = await J("/api/autoscaler");
+  document.getElementById("auto").innerHTML = head(["pool workers","idle","pending tasks","max workers"]) +
+   row([a.pool_workers, a.idle_workers, a.pending_tasks, a.max_workers]);
+  const actors = await J("/api/actors");
+  document.getElementById("actors").innerHTML = head(["actor","name","state","pid","restarts"]) +
+   actors.map(x => row([x.actor_id, x.name ?? "", x.state, x.pid ?? "", x.restarts])).join("");
+  const jobs = await J("/api/jobs");
+  document.getElementById("jobs").innerHTML = head(["id","status","entrypoint"]) +
+   jobs.map(j => row([j.submission_id, j.status, j.entrypoint ?? ""])).join("");
+  const tasks = await J("/api/tasks");
+  document.getElementById("tasks").innerHTML = head(["task","name","state","duration_s"]) +
+   tasks.slice(0, 25).map(t =>
+     row([t.task_id, t.name ?? "", t.state, t.duration_s ? t.duration_s.toFixed(3) : ""])).join("");
+  document.getElementById("err").textContent = "";
+ }catch(e){ document.getElementById("err").textContent = "refresh failed: " + e; }
+}
+refresh(); setInterval(refresh, 2000);
+</script></body></html>
+"""
+
+
+def _cluster_snapshots(client):
+    """Cluster health as metric snapshots (resources, workers, store, demand)."""
+    total, avail = client.resources()
+    auto = client.autoscaler_status()
+    node = client.state("nodes")[0]
+    by_state: dict = {}
+    for w in client.state("workers"):
+        k = (("state", w["state"]),)
+        by_state[k] = by_state.get(k, 0) + 1
+    gauge = lambda name, desc, values: {
+        "type": "gauge", "name": name, "description": desc, "values": values}
+    tagged = lambda d, tag: {((tag, k),): v for k, v in d.items()}
+    return [
+        gauge("ray_tpu_resource_total", "cluster resource totals",
+              tagged(total, "resource")),
+        gauge("ray_tpu_resource_available", "cluster resources available",
+              tagged(avail, "resource")),
+        gauge("ray_tpu_workers", "worker processes by state", by_state),
+        gauge("ray_tpu_pool_workers", "alive pool workers",
+              {(): auto["pool_workers"]}),
+        gauge("ray_tpu_pending_tasks", "tasks waiting for dispatch",
+              {(): auto["pending_tasks"]}),
+        gauge("ray_tpu_object_store_used_bytes", "shm store bytes in use",
+              {(): node["object_store_used"]}),
+        gauge("ray_tpu_object_store_capacity_bytes", "shm store capacity",
+              {(): node["object_store_capacity"]}),
+    ]
+
+
+def _prometheus_text(snapshots) -> str:
+    """Render util.metrics snapshots in Prometheus text exposition format
+    (ref: ray's metrics agent scrape endpoint)."""
+    def lbl(k, extra=()):
+        items = tuple(k) + tuple(extra)
+        if not items:
+            return ""
+        return "{" + ",".join(f'{a}="{b}"' for a, b in items) + "}"
+
+    lines = []
+    for m in snapshots:
+        name = m["name"].replace(".", "_").replace("-", "_")
+        if m.get("description"):
+            lines.append(f"# HELP {name} {m['description']}")
+        lines.append(f"# TYPE {name} {m['type']}")
+        if m["type"] in ("counter", "gauge"):
+            for k, v in m["values"].items():
+                lines.append(f"{name}{lbl(k)} {v}")
+        else:  # histogram
+            for k, buckets in m["buckets"].items():
+                cum = 0
+                for bound, cnt in zip(m["boundaries"], buckets):
+                    cum += cnt
+                    lines.append(f'{name}_bucket{lbl(k, (("le", bound),))} {cum}')
+                cum += buckets[len(m["boundaries"])]
+                lines.append(f'{name}_bucket{lbl(k, (("le", "+Inf"),))} {cum}')
+                lines.append(f"{name}_sum{lbl(k)} {m['sum'][k]}")
+                lines.append(f"{name}_count{lbl(k)} {m['count'][k]}")
+    return "\n".join(lines) + "\n"
 
 
 def start_dashboard(host: str = "127.0.0.1", port: int = 8265

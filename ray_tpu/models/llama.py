@@ -164,9 +164,13 @@ class Attention(nn.Module):
                            positions[:, -1] + 1)[:, None]
             else:
                 # prefill of a fresh row: nothing cached to read back, so
-                # plain causal attention over the prompt is exact
-                out = (flash_attention(q, k, v, causal=True)
-                       if jax.default_backend() == "tpu"
+                # plain causal attention over the prompt is exact. Honors
+                # attn_impl like the cache=None branch ("ring" needs an sp
+                # mesh axis that the serving path doesn't have → xla).
+                impl = cfg.attn_impl
+                if impl in ("auto", "ring"):
+                    impl = "flash" if jax.default_backend() == "tpu" else "xla"
+                out = (flash_attention(q, k, v, causal=True) if impl == "flash"
                        else mha_reference(q, k, v, causal=True))
             new_cache_kv = cache
         elif cache is not None:

@@ -207,16 +207,22 @@ class LLMServer:
             await asyncio.sleep(0.005)
         slot_idx = self._free.pop()
         self._req_counter += 1
-        if mgr is not None:
-            row = mgr.allocate(slot_idx, P + max_tokens)
-            self.cache = self.cache.replace(
-                block_tables=self.cache.block_tables.at[slot_idx].set(
-                    jnp.asarray(row, jnp.int32)))
-        bucket = self._bucket(P)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, :P] = prompt_ids
-        self.cache, last_logits = self._prefill(
-            self.params, self.cache, jnp.asarray(padded), slot_idx, P)
+        try:
+            if mgr is not None:
+                row = mgr.allocate(slot_idx, P + max_tokens)
+                self.cache = self.cache.replace(
+                    block_tables=self.cache.block_tables.at[slot_idx].set(
+                        jnp.asarray(row, jnp.int32)))
+            bucket = self._bucket(P)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :P] = prompt_ids
+            self.cache, last_logits = self._prefill(
+                self.params, self.cache, jnp.asarray(padded), slot_idx, P)
+        except BaseException:
+            # prefill failure must not strand the slot/pages: later requests
+            # would otherwise spin in the admission loop forever
+            self._release_slot(slot_idx)
+            raise
         import jax
         self._sample_key, sub = jax.random.split(self._sample_key)
         first = int(self._sample_first(last_logits, sub))
