@@ -1,0 +1,259 @@
+"""ctypes binding for the C++ scheduler ready-queue (src/sched_queue.cpp).
+
+`ReadyQueue` is the controller-facing API: tasks are pushed with a
+scheduling signature (pool, resource demand), `next_dispatchable()` returns
+the earliest task whose demand fits its pool (optionally masked by
+signature), and claims/releases keep the C++ pool mirror in sync with the
+controller's dict accounting. `PyReadyQueue` is the semantically identical
+pure-Python fallback used when the toolchain is unavailable (and as the
+oracle in the equivalence tests).
+
+Build: on-demand g++, cached next to the source keyed by mtime — same
+recipe as the shm store binding (_native/store.py).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "src", "sched_queue.cpp")
+_BUILD_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "_build")
+_lock = threading.Lock()
+_lib = None
+_build_error: Optional[str] = None
+
+
+def _compile() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so = os.path.join(_BUILD_DIR, "libsched_queue.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
+        return so
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC,
+           "-o", so + ".tmp"]
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(f"sched_queue build failed: {proc.stderr[:2000]}")
+    os.replace(so + ".tmp", so)
+    return so
+
+
+def _load():
+    global _lib, _build_error
+    with _lock:
+        if _lib is not None or _build_error is not None:
+            return _lib
+        try:
+            lib = ctypes.CDLL(_compile())
+        except Exception as e:  # noqa: BLE001 - fall back to Python queue
+            _build_error = str(e)
+            return None
+        lib.sq_create.restype = ctypes.c_void_p
+        lib.sq_destroy.argtypes = [ctypes.c_void_p]
+        lib.sq_set_pool.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                    ctypes.POINTER(ctypes.c_int32),
+                                    ctypes.POINTER(ctypes.c_double),
+                                    ctypes.c_int32]
+        lib.sq_remove_pool.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sq_adjust.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                  ctypes.c_int32, ctypes.c_double]
+        lib.sq_register_sig.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                        ctypes.POINTER(ctypes.c_int32),
+                                        ctypes.POINTER(ctypes.c_double),
+                                        ctypes.c_int32]
+        lib.sq_register_sig.restype = ctypes.c_int32
+        lib.sq_push.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
+        lib.sq_remove.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sq_pending.argtypes = [ctypes.c_void_p]
+        lib.sq_pending.restype = ctypes.c_int64
+        lib.sq_pending_sig.argtypes = [ctypes.c_void_p, ctypes.c_int32]
+        lib.sq_pending_sig.restype = ctypes.c_int64
+        lib.sq_next.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_uint8),
+                                ctypes.c_int32,
+                                ctypes.POINTER(ctypes.c_int32)]
+        lib.sq_next.restype = ctypes.c_int64
+        lib.sq_pop_task.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.sq_pool_avail.argtypes = [ctypes.c_void_p, ctypes.c_int64,
+                                      ctypes.c_int32]
+        lib.sq_pool_avail.restype = ctypes.c_double
+        _lib = lib
+        return _lib
+
+
+def _vecs(demand: Dict[int, float]):
+    n = len(demand)
+    rids = (ctypes.c_int32 * n)(*demand.keys())
+    amts = (ctypes.c_double * n)(*demand.values())
+    return rids, amts, n
+
+
+class ReadyQueue:
+    """C++-backed signature-bucketed ready queue."""
+
+    def __init__(self):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError(f"native sched_queue unavailable: {_build_error}")
+        self._lib = lib
+        self._h = lib.sq_create()
+        self._interned: Dict[str, int] = {}
+
+    def close(self):
+        if self._h is not None:
+            self._lib.sq_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
+
+    # -- resource-name interning (C side works on int32 ids) ----------------
+    def rid(self, name: str) -> int:
+        if name not in self._interned:
+            self._interned[name] = len(self._interned)
+        return self._interned[name]
+
+    def _demand_ids(self, need: Dict[str, float]) -> Dict[int, float]:
+        return {self.rid(k): float(v) for k, v in need.items()}
+
+    # -- pools --------------------------------------------------------------
+    def set_pool(self, pool_id: int, avail: Dict[str, float]):
+        rids, amts, n = _vecs(self._demand_ids(avail))
+        self._lib.sq_set_pool(self._h, pool_id, rids, amts, n)
+
+    def remove_pool(self, pool_id: int):
+        self._lib.sq_remove_pool(self._h, pool_id)
+
+    def adjust(self, pool_id: int, need: Dict[str, float], sign: float):
+        for rid, amt in self._demand_ids(need).items():
+            self._lib.sq_adjust(self._h, pool_id, rid, sign * amt)
+
+    def pool_avail(self, pool_id: int, resource: str) -> float:
+        return self._lib.sq_pool_avail(self._h, pool_id, self.rid(resource))
+
+    # -- signatures / tasks -------------------------------------------------
+    def register_sig(self, pool_id: int, need: Dict[str, float]) -> int:
+        rids, amts, n = _vecs(self._demand_ids(need))
+        return self._lib.sq_register_sig(self._h, pool_id, rids, amts, n)
+
+    def push(self, task_seq: int, sig_id: int):
+        self._lib.sq_push(self._h, task_seq, sig_id)
+
+    def remove(self, task_seq: int):
+        self._lib.sq_remove(self._h, task_seq)
+
+    def pending(self) -> int:
+        return self._lib.sq_pending(self._h)
+
+    def pending_sig(self, sig_id: int) -> int:
+        return self._lib.sq_pending_sig(self._h, sig_id)
+
+    def next_dispatchable(self, sig_mask: Optional[List[bool]] = None
+                          ) -> Tuple[int, int]:
+        """(task_seq, sig_id) of the earliest fitting task, or (-1, -1)."""
+        out_sig = ctypes.c_int32(-1)
+        if sig_mask is None:
+            seq = self._lib.sq_next(self._h, None, 0, ctypes.byref(out_sig))
+        else:
+            mask = (ctypes.c_uint8 * len(sig_mask))(*[1 if m else 0
+                                                      for m in sig_mask])
+            seq = self._lib.sq_next(self._h, mask, len(sig_mask),
+                                    ctypes.byref(out_sig))
+        return seq, out_sig.value
+
+    def pop_task(self, task_seq: int):
+        self._lib.sq_pop_task(self._h, task_seq)
+
+
+class PyReadyQueue:
+    """Pure-Python mirror of ReadyQueue (fallback + test oracle)."""
+
+    _EPS = 1e-9
+
+    def __init__(self):
+        self._pools: Dict[int, Dict[str, float]] = {}
+        self._sigs: List[Tuple[int, Dict[str, float], List[int]]] = []
+        self._alive: Dict[int, int] = {}  # seq -> sig
+
+    def close(self):
+        pass
+
+    def rid(self, name: str) -> int:  # parity no-op
+        return 0
+
+    def set_pool(self, pool_id, avail):
+        self._pools[pool_id] = dict(avail)
+
+    def remove_pool(self, pool_id):
+        self._pools.pop(pool_id, None)
+
+    def adjust(self, pool_id, need, sign):
+        pool = self._pools.setdefault(pool_id, {})
+        for k, v in need.items():
+            pool[k] = pool.get(k, 0.0) + sign * float(v)
+
+    def pool_avail(self, pool_id, resource):
+        return self._pools.get(pool_id, {}).get(resource, 0.0)
+
+    def register_sig(self, pool_id, need):
+        self._sigs.append((pool_id, dict(need), []))
+        return len(self._sigs) - 1
+
+    def push(self, task_seq, sig_id):
+        self._sigs[sig_id][2].append(task_seq)
+        self._alive[task_seq] = sig_id
+
+    def remove(self, task_seq):
+        self._alive.pop(task_seq, None)
+
+    def pending(self):
+        return len(self._alive)
+
+    def pending_sig(self, sig_id):
+        return sum(1 for s in self._sigs[sig_id][2] if s in self._alive)
+
+    def _fits(self, pool_id, need):
+        # absent pool -> never fits (MUST match sq_next's pools.find skip,
+        # even for zero-demand signatures)
+        pool = self._pools.get(pool_id)
+        if pool is None:
+            return False
+        return all(pool.get(k, 0.0) + self._EPS >= v for k, v in need.items())
+
+    def next_dispatchable(self, sig_mask=None):
+        best = (-1, -1)
+        for i, (pool_id, need, fifo) in enumerate(self._sigs):
+            if sig_mask is not None and i < len(sig_mask) and not sig_mask[i]:
+                continue
+            while fifo and fifo[0] not in self._alive:
+                fifo.pop(0)
+            if not fifo:
+                continue
+            if best[0] != -1 and fifo[0] >= best[0]:
+                continue
+            if self._fits(pool_id, need):
+                best = (fifo[0], i)
+        return best
+
+    def pop_task(self, task_seq):
+        sig = self._alive.pop(task_seq, None)
+        if sig is not None:
+            try:
+                self._sigs[sig][2].remove(task_seq)
+            except ValueError:
+                pass
+
+
+def make_ready_queue():
+    """ReadyQueue if the native build works, else PyReadyQueue."""
+    if os.environ.get("RAY_TPU_NO_NATIVE_SCHEDQ"):
+        return PyReadyQueue()
+    try:
+        return ReadyQueue()
+    except RuntimeError:
+        return PyReadyQueue()

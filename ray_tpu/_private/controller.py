@@ -50,6 +50,7 @@ class TaskRecord:
     spec: TaskSpec
     result_oids: List[str]
     state: str = PENDING
+    rq_seq: int = -1  # ready-index sequence number while queued
     retries_left: int = 0
     reconstructions_left: int = -1  # lazily set on first lineage recovery
     worker_id: Optional[str] = None
@@ -62,6 +63,178 @@ class TaskRecord:
     cancelled: bool = False
     pinned_actors: List[str] = field(default_factory=list)
     pinned_streams: List[str] = field(default_factory=list)
+
+
+class _ReadyIndex:
+    """Ready queue over the C++ signature-bucketed index (src/sched_queue.cpp,
+    ctypes via _native/schedq.py; Python mirror when the toolchain is absent).
+
+    Reference contrast: raylet's ClusterTaskManager keeps per-scheduling-class
+    C++ queues. Tasks are bucketed by (pool, demand, env_key, tpu, creation);
+    `next_rec` asks the index for the earliest pending task whose demand fits
+    its pool, masked by worker availability per signature — O(#signatures)
+    per dispatch instead of rescanning every queued task. The controller's
+    dict pools stay the source of truth; _claim/_release mirror into the
+    index, and the dispatch loop re-checks fit against the dicts as an
+    invariant."""
+
+    def __init__(self, controller):
+        from ray_tpu._native.schedq import make_ready_queue
+        self.c = controller
+        self.q = make_ready_queue()
+        self.recs: "collections.OrderedDict[int, TaskRecord]" = collections.OrderedDict()
+        self._seq = 0
+        self._sig_cache: Dict[tuple, int] = {}
+        self._sig_meta: List[dict] = []      # sig_id -> meta dict
+        self._pool_ids: Dict[int, int] = {}  # id(pool dict) -> index pool id
+        self._pool_free: List[int] = []      # reusable index pool ids
+        self._pg_sigs: Dict[str, List[int]] = collections.defaultdict(list)
+        self._next_pool = 0
+
+    # -- pools (mirrors of the controller's dict pools) ----------------------
+    def register_pool(self, pool: Dict[str, float]) -> int:
+        # reuse retired ids so placement-group churn doesn't grow the index
+        pid = self._pool_free.pop() if self._pool_free else self._next_pool
+        if pid == self._next_pool:
+            self._next_pool += 1
+        self._pool_ids[id(pool)] = pid
+        self.q.set_pool(pid, pool)
+        return pid
+
+    def drop_pool(self, pool: Dict[str, float]):
+        pid = self._pool_ids.pop(id(pool), None)
+        if pid is not None:
+            self.q.remove_pool(pid)
+            self._pool_free.append(pid)
+
+    def retire_pg_sigs(self, pg_id: str):
+        """Placement group removed: its signatures go dead (masked forever,
+        dropped from the cache so the key space stays bounded)."""
+        for sig in self._pg_sigs.pop(pg_id, []):
+            self._sig_meta[sig]["dead"] = True
+        self._sig_cache = {k: v for k, v in self._sig_cache.items()
+                           if not self._sig_meta[v].get("dead")}
+
+    def adjust(self, pool: Dict[str, float], need: Dict[str, float], sign: float):
+        pid = self._pool_ids.get(id(pool))
+        if pid is not None and need:
+            self.q.adjust(pid, need, sign)
+
+    # -- enqueue / remove ----------------------------------------------------
+    def _pool_key_for(self, spec: TaskSpec) -> int:
+        if spec.placement_group_id:
+            pg = self.c.pgroups.get(spec.placement_group_id)
+            if pg is None:
+                return -1  # unregistered pool: never fits, task pends
+            idx = spec.placement_group_bundle_index
+            bundle = pg.bundles[idx if idx >= 0 else 0]
+            return self._pool_ids.get(id(bundle.available), -1)
+        return self._pool_ids.get(id(self.c.available), 0)
+
+    def _sig_for(self, spec: TaskSpec) -> int:
+        from .runtime_env import runtime_env_key
+        pool_key = self._pool_key_for(spec)
+        env_key = runtime_env_key(spec.runtime_env)
+        tpu = spec.resources.get("TPU", 0) > 0
+        pg_id = spec.placement_group_id
+        key = (pool_key, pg_id, tuple(sorted(spec.resources.items())),
+               env_key, tpu, spec.is_actor_creation)
+        sig = self._sig_cache.get(key)
+        if sig is None:
+            sig = self.q.register_sig(pool_key, spec.resources)
+            self._sig_cache[key] = sig
+            if pg_id:
+                bidx = spec.placement_group_bundle_index
+
+                def pool_ref(pg_id=pg_id, bidx=bidx):
+                    pg = self.c.pgroups.get(pg_id)
+                    if pg is None:
+                        return None
+                    return pg.bundles[bidx if bidx >= 0 else 0].available
+
+                self._pg_sigs[pg_id].append(sig)
+            else:
+                pool_ref = lambda: self.c.available  # noqa: E731
+            self._sig_meta.append({
+                "env_key": env_key, "tpu": tpu,
+                "creation": spec.is_actor_creation,
+                "need": dict(spec.resources),
+                "runtime_env": spec.runtime_env,
+                "pool_ref": pool_ref, "dead": False})
+        return sig
+
+    def append(self, rec: TaskRecord):
+        self._seq += 1
+        rec.rq_seq = self._seq
+        self.recs[self._seq] = rec
+        self.q.push(self._seq, self._sig_for(rec.spec))
+
+    def remove(self, rec: TaskRecord):
+        """Lazy cancel: mark dead in the index (O(1)); the bucket sheds dead
+        entries as they reach its front. Eager pop_task here would rescan the
+        bucket per removal — O(n²) on mass cancellation."""
+        if rec.rq_seq in self.recs:
+            del self.recs[rec.rq_seq]
+            self.q.remove(rec.rq_seq)
+
+    def take(self, rec: TaskRecord):
+        """Dispatch-path removal: the rec is its bucket's front (next_rec just
+        returned it), so pop_task is O(1)."""
+        if rec.rq_seq in self.recs:
+            del self.recs[rec.rq_seq]
+            self.q.pop_task(rec.rq_seq)
+
+    def __len__(self):
+        return len(self.recs)
+
+    def __iter__(self):
+        return iter(list(self.recs.values()))
+
+    # -- dispatch selection --------------------------------------------------
+    def sig_mask(self, deferred: Set[int]) -> List[bool]:
+        # one pass over workers, then O(sigs) set lookups — not
+        # O(sigs × workers)
+        idle = {(w.tpu_capable, w.env_key)
+                for w in self.c.workers.values()
+                if w.state == "idle" and w.actor_id is None}
+        mask = []
+        for sig_id, meta in enumerate(self._sig_meta):
+            if sig_id in deferred or meta["dead"]:
+                mask.append(False)
+            elif meta["creation"]:
+                mask.append(True)  # creations spawn their own worker
+            else:
+                mask.append((meta["tpu"], meta["env_key"]) in idle)
+        return mask
+
+    def next_rec(self, mask: List[bool]):
+        """(rec_or_None, sig_id, seq); seq == -1 means nothing dispatchable.
+        rec None with seq != -1 is a stale index entry the caller drops."""
+        seq, sig = self.q.next_dispatchable(mask)
+        if seq == -1:
+            return None, -1, -1
+        return self.recs.get(seq), sig, seq
+
+    def drop_seq(self, seq: int):
+        self.recs.pop(seq, None)
+        self.q.pop_task(seq)  # it was the bucket front — O(1)
+
+    # -- per-signature aggregates (keeps demand counting O(#signatures)) -----
+    def demand_by_sig(self):
+        """[(meta, live_count)] for non-creation signatures whose demand
+        currently fits their pool (pool checked against the dict truth)."""
+        out = []
+        for sig_id, meta in enumerate(self._sig_meta):
+            if meta["creation"] or meta["dead"]:
+                continue
+            n = self.q.pending_sig(sig_id)
+            if not n:
+                continue
+            pool = meta["pool_ref"]()
+            if pool is None or not self.c._resources_fit(meta["need"], pool):
+                continue
+            out.append((meta, n))
+        return out
 
 
 @dataclass
@@ -153,7 +326,8 @@ class Controller:
         self.object_events: Dict[str, asyncio.Event] = {}
         self.lineage: Dict[str, str] = {}  # evicted oid -> creating task id
         self.tasks: Dict[str, TaskRecord] = {}
-        self.ready_queue: collections.deque = collections.deque()
+        self.ready_queue = _ReadyIndex(self)
+        self.ready_queue.register_pool(self.available)  # cluster pool = 0
         self.dep_waiters: Dict[str, Set[str]] = collections.defaultdict(set)
         self.workers: Dict[str, WorkerConn] = {}
         self.spawning: Dict[str, WorkerConn] = {}
@@ -519,14 +693,20 @@ class Controller:
     def _claim(self, need: Dict[str, float], pool: Dict[str, float]):
         for k, v in need.items():
             pool[k] = pool.get(k, 0) - v
+        self.ready_queue.adjust(pool, need, -1)
 
     def _release(self, need: Dict[str, float], pool: Dict[str, float]):
         for k, v in need.items():
             pool[k] = pool.get(k, 0) + v
+        self.ready_queue.adjust(pool, need, +1)
 
-    def _task_pool(self, spec: TaskSpec) -> Dict[str, float]:
+    def _task_pool(self, spec: TaskSpec) -> Optional[Dict[str, float]]:
+        """The pool a task draws from; None when its placement group is gone
+        (the task is being failed by remove_placement_group)."""
         if spec.placement_group_id:
-            pg = self.pgroups[spec.placement_group_id]
+            pg = self.pgroups.get(spec.placement_group_id)
+            if pg is None:
+                return None
             idx = spec.placement_group_bundle_index
             bundle = pg.bundles[idx if idx >= 0 else 0]
             return bundle.available
@@ -537,46 +717,53 @@ class Controller:
         raylet's ScheduleAndDispatchTasks)."""
         if self._shutdown:
             return
-        # 1. plain tasks → idle pool workers
-        progressing = True
-        while progressing:
-            progressing = False
-            for _ in range(len(self.ready_queue)):
-                rec = self.ready_queue.popleft()
-                if rec.state != PENDING:
-                    continue
-                pool = self._task_pool(rec.spec)
-                if not self._resources_fit(rec.spec.resources, pool):
-                    self.ready_queue.append(rec)
-                    continue
-                if rec.spec.is_actor_creation:
-                    progressing = self._start_actor_worker(rec, pool) or progressing
-                    continue
-                w = self._find_idle_worker(
-                    need_tpu=rec.spec.resources.get("TPU", 0) > 0,
-                    env_key=runtime_env_key(rec.spec.runtime_env))
-                if w is None:
-                    self.ready_queue.append(rec)
-                    continue
-                self._claim(rec.spec.resources, pool)
-                self._assign_tpus(rec)
-                self._dispatch(rec, w)
-                progressing = True
+        # 1. plain tasks → idle pool workers. The ready index returns the
+        # earliest queued task whose demand fits its pool among signatures
+        # with an idle matching worker; the mask is rebuilt per dispatch so
+        # one pass drains everything currently dispatchable. A signature is
+        # deferred for the rest of this pass when its env is still building
+        # or the index/dict accounting disagrees (invariant re-check).
+        deferred: Set[int] = set()
+        while True:
+            rec, sig, seq = self.ready_queue.next_rec(
+                self.ready_queue.sig_mask(deferred))
+            if seq == -1:
+                break
+            if rec is None or rec.state != PENDING:
+                self.ready_queue.drop_seq(seq)
+                continue
+            pool = self._task_pool(rec.spec)
+            if pool is None or not self._resources_fit(rec.spec.resources, pool):
+                deferred.add(sig)  # mirror drift; dict pool is the truth
+                continue
+            if rec.spec.is_actor_creation:
+                self.ready_queue.take(rec)
+                if not self._start_actor_worker(rec, pool):
+                    deferred.add(sig)  # env building; rec was re-queued
+                continue
+            w = self._find_idle_worker(
+                need_tpu=rec.spec.resources.get("TPU", 0) > 0,
+                env_key=runtime_env_key(rec.spec.runtime_env))
+            if w is None:
+                deferred.add(sig)
+                continue
+            self.ready_queue.take(rec)
+            self._claim(rec.spec.resources, pool)
+            self._assign_tpus(rec)
+            self._dispatch(rec, w)
         # spawn workers to match queued demand (never more than cpu slots),
-        # grouped by runtime_env so each env gets workers built for it
+        # grouped by runtime_env so each env gets workers built for it.
+        # Aggregated per signature — O(#signatures), not O(pending tasks).
         demand: Dict[Optional[str], int] = {}
         tpu_demand: Dict[Optional[str], int] = {}
         env_specs: Dict[Optional[str], Optional[dict]] = {}
-        for rec in self.ready_queue:
-            if (rec.state == PENDING and not rec.spec.is_actor_creation
-                    and self._resources_fit(rec.spec.resources,
-                                            self._task_pool(rec.spec))):
-                key = runtime_env_key(rec.spec.runtime_env)
-                env_specs.setdefault(key, rec.spec.runtime_env)
-                if rec.spec.resources.get("TPU", 0) > 0:
-                    tpu_demand[key] = tpu_demand.get(key, 0) + 1
-                else:
-                    demand[key] = demand.get(key, 0) + 1
+        for meta, n in self.ready_queue.demand_by_sig():
+            key = meta["env_key"]
+            env_specs.setdefault(key, meta["runtime_env"])
+            if meta["tpu"]:
+                tpu_demand[key] = tpu_demand.get(key, 0) + n
+            else:
+                demand[key] = demand.get(key, 0) + n
         self._spawn_for_demand(demand, tpu_demand, env_specs)
         # 2. actor method calls → their dedicated workers
         for actor in self.actors.values():
@@ -971,6 +1158,7 @@ class Controller:
     def _fail_task(self, rec: TaskRecord, err: Exception):
         was_terminal = rec.state in (DONE, FAILED, CANCELLED)
         rec.state = CANCELLED if isinstance(err, exc.TaskCancelledError) else FAILED
+        self.ready_queue.remove(rec)  # no-op unless still queued
         if not was_terminal:
             self._mark_task_terminal(rec)
         self._unpin(rec)
@@ -1556,11 +1744,8 @@ class Controller:
             return
         rec.cancelled = True
         if rec.state in (PENDING, PENDING_DEPS):
+            # _fail_task also removes the rec from the ready index
             self._fail_task(rec, exc.TaskCancelledError(task_id))
-            try:
-                self.ready_queue.remove(rec)
-            except ValueError:
-                pass
             if rec.spec.actor_id and not rec.spec.is_actor_creation:
                 actor = self.actors.get(rec.spec.actor_id)
                 if actor is not None:
@@ -1626,7 +1811,9 @@ class Controller:
         bs = []
         for b in bundles:
             self._claim(b, self.available)
-            bs.append(Bundle(resources=dict(b), available=dict(b)))
+            bundle = Bundle(resources=dict(b), available=dict(b))
+            self.ready_queue.register_pool(bundle.available)
+            bs.append(bundle)
         self.pgroups[pg_id] = PlacementGroupRecord(pg_id=pg_id, bundles=bs,
                                                    strategy=strategy, name=name)
         return pg_id
@@ -1635,7 +1822,16 @@ class Controller:
         pg = self.pgroups.pop(pg_id, None)
         if pg is None:
             return
+        # queued tasks bound to this group can never run (ref: reference
+        # fails tasks of a removed PG) — fail them before dropping the pools
+        for rec in list(self.ready_queue):
+            if (rec.state == PENDING
+                    and rec.spec.placement_group_id == pg_id):
+                self._fail_task(rec, ValueError(
+                    f"placement group {pg_id} removed while task queued"))
+        self.ready_queue.retire_pg_sigs(pg_id)
         for b in pg.bundles:
+            self.ready_queue.drop_pool(b.available)
             self._release(b.resources, self.available)
 
     # ------------------------------------------------------------------- state

@@ -1,0 +1,181 @@
+// Scheduler ready-queue: signature-bucketed pending-task index.
+//
+// Reference contrast: the raylet's C++ ClusterTaskManager keeps per-
+// scheduling-class queues and dispatches by resource fit
+// (src/ray/raylet/scheduling/cluster_task_manager.cc). The Python
+// controller's original dispatch loop rescanned the whole ready deque after
+// every state change — O(pending) per completion, O(n^2) during task
+// storms. This index groups tasks by their scheduling SIGNATURE
+// (pool, resource demand, env key, tpu flag): distinct signatures stay few
+// no matter how many tasks queue, so `sq_next` scans signatures, not tasks,
+// and global FIFO fairness is kept by comparing the front sequence number of
+// every fitting bucket.
+//
+// Exposed as a flat C ABI for ctypes (ray_tpu/_native/schedq.py); the
+// controller mirrors claims/releases so pool state here always matches its
+// dict accounting (asserted by the equivalence tests).
+
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr double kEps = 1e-9;
+
+struct Pool {
+  // resource id -> available amount
+  std::unordered_map<int32_t, double> avail;
+};
+
+struct Signature {
+  int64_t pool_id = 0;
+  std::vector<std::pair<int32_t, double>> demand;
+  std::deque<int64_t> fifo;  // pending task sequence numbers, FIFO
+};
+
+struct SchedQueue {
+  std::unordered_map<int64_t, Pool> pools;
+  std::vector<Signature> sigs;
+  // task seq -> (sig index, alive). Removal marks dead; buckets skip dead
+  // entries lazily so cancel stays O(1).
+  std::unordered_map<int64_t, std::pair<int32_t, bool>> tasks;
+  int64_t pending = 0;
+};
+
+bool fits(const Pool& pool, const Signature& sig) {
+  for (const auto& [rid, amt] : sig.demand) {
+    auto it = pool.avail.find(rid);
+    double have = (it == pool.avail.end()) ? 0.0 : it->second;
+    if (have + kEps < amt) return false;
+  }
+  return true;
+}
+
+void drop_dead_front(SchedQueue* q, Signature& sig) {
+  while (!sig.fifo.empty()) {
+    auto it = q->tasks.find(sig.fifo.front());
+    if (it != q->tasks.end() && it->second.second) return;
+    sig.fifo.pop_front();
+    if (it != q->tasks.end()) q->tasks.erase(it);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* sq_create() { return new SchedQueue(); }
+
+void sq_destroy(void* h) { delete static_cast<SchedQueue*>(h); }
+
+// Upsert a pool's availability (n parallel arrays of resource id / amount).
+void sq_set_pool(void* h, int64_t pool_id, const int32_t* rids,
+                 const double* amts, int32_t n) {
+  auto* q = static_cast<SchedQueue*>(h);
+  Pool& p = q->pools[pool_id];
+  p.avail.clear();
+  for (int32_t i = 0; i < n; ++i) p.avail[rids[i]] = amts[i];
+}
+
+void sq_remove_pool(void* h, int64_t pool_id) {
+  static_cast<SchedQueue*>(h)->pools.erase(pool_id);
+}
+
+// Adjust one resource of one pool by delta (claim: negative, release:
+// positive). Absent resources start at 0.
+void sq_adjust(void* h, int64_t pool_id, int32_t rid, double delta) {
+  auto* q = static_cast<SchedQueue*>(h);
+  q->pools[pool_id].avail[rid] += delta;
+}
+
+// Register a signature (scheduling class). Returns its id.
+int32_t sq_register_sig(void* h, int64_t pool_id, const int32_t* rids,
+                        const double* amts, int32_t n) {
+  auto* q = static_cast<SchedQueue*>(h);
+  Signature s;
+  s.pool_id = pool_id;
+  s.demand.reserve(n);
+  for (int32_t i = 0; i < n; ++i) s.demand.emplace_back(rids[i], amts[i]);
+  q->sigs.push_back(std::move(s));
+  return static_cast<int32_t>(q->sigs.size()) - 1;
+}
+
+void sq_push(void* h, int64_t task_seq, int32_t sig_id) {
+  auto* q = static_cast<SchedQueue*>(h);
+  q->sigs[sig_id].fifo.push_back(task_seq);
+  q->tasks[task_seq] = {sig_id, true};
+  ++q->pending;
+}
+
+// Mark a task dead (cancelled / failed while queued). O(1).
+void sq_remove(void* h, int64_t task_seq) {
+  auto* q = static_cast<SchedQueue*>(h);
+  auto it = q->tasks.find(task_seq);
+  if (it == q->tasks.end() || !it->second.second) return;
+  it->second.second = false;
+  --q->pending;
+}
+
+int64_t sq_pending(void* h) { return static_cast<SchedQueue*>(h)->pending; }
+
+// Pending count for one signature (live entries only, O(bucket)).
+int64_t sq_pending_sig(void* h, int32_t sig_id) {
+  auto* q = static_cast<SchedQueue*>(h);
+  int64_t n = 0;
+  for (int64_t seq : q->sigs[sig_id].fifo) {
+    auto it = q->tasks.find(seq);
+    if (it != q->tasks.end() && it->second.second) ++n;
+  }
+  return n;
+}
+
+// Earliest pending task whose signature's demand fits its pool, subject to a
+// caller-supplied signature mask (mask[sig]=1 → eligible; the controller
+// masks out signatures with no matching idle worker). Does NOT pop — the
+// caller claims resources and then calls sq_pop_task. Returns -1 if none.
+int64_t sq_next(void* h, const uint8_t* sig_mask, int32_t mask_len,
+                int32_t* out_sig) {
+  auto* q = static_cast<SchedQueue*>(h);
+  int64_t best_seq = -1;
+  int32_t best_sig = -1;
+  for (int32_t i = 0; i < static_cast<int32_t>(q->sigs.size()); ++i) {
+    if (sig_mask && i < mask_len && !sig_mask[i]) continue;
+    Signature& sig = q->sigs[i];
+    drop_dead_front(q, sig);
+    if (sig.fifo.empty()) continue;
+    int64_t front = sig.fifo.front();
+    if (best_seq != -1 && front >= best_seq) continue;  // FIFO fairness
+    auto pit = q->pools.find(sig.pool_id);
+    if (pit == q->pools.end() || !fits(pit->second, sig)) continue;
+    best_seq = front;
+    best_sig = i;
+  }
+  if (out_sig) *out_sig = best_sig;
+  return best_seq;
+}
+
+// Pop a specific task (the one sq_next returned) from its bucket.
+void sq_pop_task(void* h, int64_t task_seq) {
+  auto* q = static_cast<SchedQueue*>(h);
+  auto it = q->tasks.find(task_seq);
+  if (it == q->tasks.end()) return;
+  Signature& sig = q->sigs[it->second.first];
+  if (it->second.second) --q->pending;
+  q->tasks.erase(it);
+  for (auto dit = sig.fifo.begin(); dit != sig.fifo.end(); ++dit) {
+    if (*dit == task_seq) { sig.fifo.erase(dit); break; }
+  }
+}
+
+double sq_pool_avail(void* h, int64_t pool_id, int32_t rid) {
+  auto* q = static_cast<SchedQueue*>(h);
+  auto it = q->pools.find(pool_id);
+  if (it == q->pools.end()) return 0.0;
+  auto rit = it->second.avail.find(rid);
+  return rit == it->second.avail.end() ? 0.0 : rit->second;
+}
+
+}  // extern "C"

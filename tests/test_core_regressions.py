@@ -175,3 +175,31 @@ def test_closure_captured_ref_pinned_for_fn_lifetime(ray_session):
     assert ray.get(out, timeout=60) == 7
     # second call after the driver's handle is long gone
     assert ray.get(rf.remote(), timeout=60) == 7
+
+
+def test_task_storm_dispatch(ray_session):
+    """Hundreds of queued tasks drain correctly through the signature-
+    bucketed ready index (src/sched_queue.cpp) — ordering-independent
+    results, mixed resource demands, no starvation."""
+    ray = ray_session
+
+    @ray.remote
+    def tiny(i):
+        return i
+
+    @ray.remote(num_cpus=2)
+    def chunky(i):
+        return -i
+
+    refs = []
+    for i in range(150):
+        refs.append(tiny.remote(i))
+        if i % 10 == 0:
+            refs.append(chunky.remote(i))
+    out = ray.get(refs, timeout=240)
+    expect = []
+    for i in range(150):
+        expect.append(i)
+        if i % 10 == 0:
+            expect.append(-i)
+    assert out == expect

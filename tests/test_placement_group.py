@@ -55,3 +55,31 @@ def test_strict_spread_infeasible_on_one_node(ray_session):
 def test_spread_accepted_single_node(ray_session):
     pg = placement_group([{"CPU": 1}, {"CPU": 1}], strategy="SPREAD")
     remove_placement_group(pg)
+
+
+def test_remove_pg_fails_queued_tasks(ray_session):
+    """Removing a group with tasks still queued fails them loudly instead of
+    wedging the scheduler (r3 review finding), and later tasks still run."""
+    ray = ray_session
+    pg = placement_group([{"CPU": 1}], strategy="PACK")
+
+    @ray.remote
+    def blocker():
+        import time
+        time.sleep(1.5)
+        return "done"
+
+    @ray.remote
+    def queued():
+        return "ran"
+
+    strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+    running = blocker.options(scheduling_strategy=strat).remote()
+    stuck = queued.options(scheduling_strategy=strat).remote()
+    import time
+    time.sleep(0.3)  # let blocker occupy the bundle; `stuck` stays queued
+    remove_placement_group(pg)
+    with pytest.raises(Exception, match="placement group|removed"):
+        ray.get(stuck, timeout=60)
+    # the scheduler keeps working afterwards
+    assert ray.get(queued.remote(), timeout=60) == "ran"
