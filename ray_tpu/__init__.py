@@ -22,6 +22,7 @@ from .api import (
     is_initialized,
     kill,
     nodes,
+    object_ref_from_id,
     put,
     remote,
     shutdown,
@@ -38,7 +39,7 @@ __all__ = [
     "DynamicObjectRefGenerator", "RemoteFunction",
     "available_resources", "cancel", "cluster_resources", "exceptions",
     "exit_actor", "get", "get_actor", "get_runtime_context", "get_tpu_ids",
-    "init", "is_initialized", "kill", "method", "nodes", "put", "remote",
+    "init", "is_initialized", "kill", "method", "nodes", "object_ref_from_id", "put", "remote",
     "shutdown", "timeline", "wait",
 ]
 

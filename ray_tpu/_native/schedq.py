@@ -64,6 +64,7 @@ def _load():
                                         ctypes.POINTER(ctypes.c_double),
                                         ctypes.c_int32]
         lib.sq_register_sig.restype = ctypes.c_int32
+        lib.sq_retire_sig.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.sq_push.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32]
         lib.sq_remove.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.sq_pending.argtypes = [ctypes.c_void_p]
@@ -141,6 +142,9 @@ class ReadyQueue:
         rids, amts, n = _vecs(self._demand_ids(need))
         return self._lib.sq_register_sig(self._h, pool_id, rids, amts, n)
 
+    def retire_sig(self, sig_id: int):
+        self._lib.sq_retire_sig(self._h, sig_id)
+
     def push(self, task_seq: int, sig_id: int):
         self._lib.sq_push(self._h, task_seq, sig_id)
 
@@ -178,6 +182,8 @@ class PyReadyQueue:
     def __init__(self):
         self._pools: Dict[int, Dict[str, float]] = {}
         self._sigs: List[Tuple[int, Dict[str, float], List[int]]] = []
+        self._free_sigs: List[int] = []
+        self._live: Dict[int, int] = {}   # sig -> live count
         self._alive: Dict[int, int] = {}  # seq -> sig
 
     def close(self):
@@ -201,21 +207,37 @@ class PyReadyQueue:
         return self._pools.get(pool_id, {}).get(resource, 0.0)
 
     def register_sig(self, pool_id, need):
-        self._sigs.append((pool_id, dict(need), []))
-        return len(self._sigs) - 1
+        if self._free_sigs:
+            sig = self._free_sigs.pop()
+            self._sigs[sig] = (pool_id, dict(need), [])
+        else:
+            self._sigs.append((pool_id, dict(need), []))
+            sig = len(self._sigs) - 1
+        self._live[sig] = 0
+        return sig
+
+    def retire_sig(self, sig_id):
+        for seq in self._sigs[sig_id][2]:
+            self._alive.pop(seq, None)
+        self._sigs[sig_id] = (self._sigs[sig_id][0], {}, [])
+        self._live[sig_id] = 0
+        self._free_sigs.append(sig_id)
 
     def push(self, task_seq, sig_id):
         self._sigs[sig_id][2].append(task_seq)
         self._alive[task_seq] = sig_id
+        self._live[sig_id] += 1
 
     def remove(self, task_seq):
-        self._alive.pop(task_seq, None)
+        sig = self._alive.pop(task_seq, None)
+        if sig is not None:
+            self._live[sig] -= 1
 
     def pending(self):
         return len(self._alive)
 
     def pending_sig(self, sig_id):
-        return sum(1 for s in self._sigs[sig_id][2] if s in self._alive)
+        return self._live.get(sig_id, 0)
 
     def _fits(self, pool_id, need):
         # absent pool -> never fits (MUST match sq_next's pools.find skip,
@@ -243,6 +265,7 @@ class PyReadyQueue:
     def pop_task(self, task_seq):
         sig = self._alive.pop(task_seq, None)
         if sig is not None:
+            self._live[sig] -= 1
             try:
                 self._sigs[sig][2].remove(task_seq)
             except ValueError:

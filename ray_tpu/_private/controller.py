@@ -108,10 +108,12 @@ class _ReadyIndex:
             self._pool_free.append(pid)
 
     def retire_pg_sigs(self, pg_id: str):
-        """Placement group removed: its signatures go dead (masked forever,
-        dropped from the cache so the key space stays bounded)."""
+        """Placement group removed: retire its signatures — queued entries
+        dropped, slots freed for reuse on both sides of the ctypes boundary,
+        cache keys pruned. Keeps long PG-churn sessions bounded."""
         for sig in self._pg_sigs.pop(pg_id, []):
             self._sig_meta[sig]["dead"] = True
+            self.q.retire_sig(sig)
         self._sig_cache = {k: v for k, v in self._sig_cache.items()
                            if not self._sig_meta[v].get("dead")}
 
@@ -155,12 +157,16 @@ class _ReadyIndex:
                 self._pg_sigs[pg_id].append(sig)
             else:
                 pool_ref = lambda: self.c.available  # noqa: E731
-            self._sig_meta.append({
+            meta = {
                 "env_key": env_key, "tpu": tpu,
                 "creation": spec.is_actor_creation,
                 "need": dict(spec.resources),
                 "runtime_env": spec.runtime_env,
-                "pool_ref": pool_ref, "dead": False})
+                "pool_ref": pool_ref, "dead": False}
+            if sig == len(self._sig_meta):
+                self._sig_meta.append(meta)
+            else:
+                self._sig_meta[sig] = meta  # reused retired slot
         return sig
 
     def append(self, rec: TaskRecord):
@@ -312,8 +318,16 @@ class PlacementGroupRecord:
 
 class Controller:
     def __init__(self, socket_path: str, resources: Dict[str, float], job_id: str,
-                 max_workers: int = None, store_capacity: int = DEFAULT_CAPACITY):
+                 max_workers: int = None, store_capacity: int = DEFAULT_CAPACITY,
+                 session_dir: str = None):
         self.socket_path = socket_path
+        # GCS fault tolerance (named sessions): journal detached actors and
+        # spilled objects so the next controller on this session restores
+        # them (ref: src/ray/gcs GCS FT via Redis; see _private/gcs.py)
+        self.gcs = None
+        if session_dir:
+            from .gcs import GcsJournal
+            self.gcs = GcsJournal(session_dir)
         self.job_id = job_id
         self.node_id = ids.node_id()
         self.loop: asyncio.AbstractEventLoop = None
@@ -368,6 +382,41 @@ class Controller:
         self.loop = asyncio.get_running_loop()
         self._server = await asyncio.start_unix_server(self._on_conn, path=self.socket_path)
         self.loop.create_task(self._reaper())
+        if self.gcs is not None:
+            await self._restore_from_journal()
+
+    async def _restore_from_journal(self):
+        """Replay the session journal: surviving spilled objects re-enter the
+        object table; detached actors re-register and restart from their
+        creation specs (fresh state, like a reference actor restart)."""
+        from .gcs import fold
+        records = self.gcs.load()
+        actors, objects = fold(records)
+        # bound journal growth across restarts: rewrite with the live set
+        self.gcs.compact(
+            list(actors.values()) +
+            [r for r in records if r.get("kind") == "spilled"
+             and r["object_id"] in objects])
+        for oid, rec in objects.items():
+            if not os.path.exists(rec["path"]):
+                continue
+            self.objects[oid] = ObjectMeta(
+                object_id=oid, size=rec["size"], meta_len=rec["meta_len"],
+                location="spilled", spill_path=rec["path"],
+                refcount=1)  # session-held ref: survives driver turnover
+            ev = asyncio.Event()
+            ev.set()
+            self.object_events[oid] = ev
+        for rec in actors.values():
+            spec, options = rec["spec"], rec["options"]
+            try:
+                self.register_actor(spec, options, _journal=False)
+                await self.submit(spec)
+            except Exception as e:  # noqa: BLE001 - a bad record must not
+                # take the whole session down; drop it with a tombstone
+                self.gcs.record("actor_dead", actor_id=spec.actor_id)
+                print(f"[gcs] failed to restore detached actor "
+                      f"{options.name!r}: {e}", file=sys.stderr)
 
     async def shutdown(self):
         self._shutdown = True
@@ -379,11 +428,15 @@ class Controller:
             if meta.location == "shm":
                 self.store.delete_segment(oid)
             elif meta.location == "spilled" and meta.spill_path:
+                if self.gcs is not None:
+                    continue  # named session: spilled objects outlive us
                 try:
                     os.remove(meta.spill_path)
                 except OSError:
                     pass
         self.objects.clear()
+        if self.gcs is not None:
+            self.gcs.close()
         self.store.close(unlink_arena=True)
         os.environ.pop("RAY_TPU_ARENA", None)
         try:
@@ -674,6 +727,12 @@ class Controller:
 
     def _enqueue_ready(self, rec: TaskRecord):
         rec.state = PENDING
+        # PG-bound work whose group vanished while it waited on deps can
+        # never dispatch — fail it now rather than queue it forever
+        if (rec.spec.placement_group_id
+                and rec.spec.placement_group_id not in self.pgroups):
+            self._fail_pg_task(rec, rec.spec.placement_group_id)
+            return
         if rec.spec.actor_id and not rec.spec.is_actor_creation:
             actor = self.actors.get(rec.spec.actor_id)
             if actor is None:
@@ -690,12 +749,18 @@ class Controller:
     def _resources_fit(self, need: Dict[str, float], pool: Dict[str, float]) -> bool:
         return all(pool.get(k, 0) + 1e-9 >= v for k, v in need.items())
 
-    def _claim(self, need: Dict[str, float], pool: Dict[str, float]):
+    def _claim(self, need: Dict[str, float], pool: Optional[Dict[str, float]]):
+        if pool is None:
+            return  # pool's placement group is gone; nothing to account
         for k, v in need.items():
             pool[k] = pool.get(k, 0) - v
         self.ready_queue.adjust(pool, need, -1)
 
-    def _release(self, need: Dict[str, float], pool: Dict[str, float]):
+    def _release(self, need: Dict[str, float], pool: Optional[Dict[str, float]]):
+        if pool is None:
+            # the PG was removed while this task ran: its bundle's resources
+            # were already returned to the cluster pool wholesale
+            return
         for k, v in need.items():
             pool[k] = pool.get(k, 0) + v
         self.ready_queue.adjust(pool, need, +1)
@@ -1229,6 +1294,10 @@ class Controller:
                     meta.spill_path = self.store.spill(oid)
                     meta.location = "spilled"
                     self.store_used -= meta.size
+                    if self.gcs is not None:
+                        self.gcs.record("spilled", object_id=oid,
+                                        path=meta.spill_path, size=meta.size,
+                                        meta_len=meta.meta_len)
                 except Exception:  # noqa: BLE001 - best-effort under pressure
                     continue
 
@@ -1239,6 +1308,8 @@ class Controller:
             meta.location = "shm"
             meta.spill_path = None
             self.store_used += meta.size
+            if self.gcs is not None:  # restore deletes the spill file
+                self.gcs.record("object_gone", object_id=oid)
 
     async def get_descriptors(self, oids: List[str], timeout: Optional[float]):
         """Wait for availability; return per-object descriptors the caller can
@@ -1410,6 +1481,8 @@ class Controller:
                 os.remove(meta.spill_path)
             except OSError:
                 pass
+            if self.gcs is not None:
+                self.gcs.record("object_gone", object_id=oid)
         self.object_events.pop(oid, None)
         if meta.creating_task:
             # lineage survives the data: a borrowed ref deserialized later can
@@ -1585,7 +1658,7 @@ class Controller:
         self._maybe_drop_stream(task_id, st)
 
     # ------------------------------------------------------------------ actors
-    def register_actor(self, spec: TaskSpec, options) -> str:
+    def register_actor(self, spec: TaskSpec, options, _journal: bool = True) -> str:
         actor = ActorRecord(actor_id=spec.actor_id, creation_spec=spec, options=options,
                             name=options.name, namespace=options.namespace or "default")
         if options.name:
@@ -1595,6 +1668,11 @@ class Controller:
                                  f"'{actor.namespace}'")
             self.named_actors[key] = actor.actor_id
         self.actors[actor.actor_id] = actor
+        if (_journal and self.gcs is not None and options.name
+                and options.lifetime == "detached"):
+            self.gcs.record("detached_actor", durable=True,
+                            actor_id=actor.actor_id,
+                            spec=spec, options=options)
         return actor.actor_id
 
     def lookup_actor(self, name: str, namespace: Optional[str]) -> str:
@@ -1646,6 +1724,9 @@ class Controller:
             return
         actor.state = A_DEAD
         actor.death_reason = reason
+        if self.gcs is not None:
+            self.gcs.record("actor_dead", durable=True,
+                            actor_id=actor.actor_id)
         if actor.name:
             self.named_actors.pop((actor.namespace, actor.name), None)
         err = exc.ActorDiedError(actor.actor_id, reason)
@@ -1818,6 +1899,19 @@ class Controller:
                                                    strategy=strategy, name=name)
         return pg_id
 
+    def _fail_pg_task(self, rec: TaskRecord, pg_id: str):
+        """Fail work whose placement group is gone; actor creations go
+        through _fail_actor so the actor record dies too (method calls fail
+        instead of queueing forever — same as the infeasible-creation path)."""
+        err = ValueError(f"placement group {pg_id} removed before this "
+                         f"work could run")
+        if rec.spec.is_actor_creation:
+            actor = self.actors.get(rec.spec.actor_id)
+            if actor is not None:
+                self._fail_actor(actor, str(err), allow_restart=False)
+                return
+        self._fail_task(rec, err)
+
     def remove_placement_group(self, pg_id: str):
         pg = self.pgroups.pop(pg_id, None)
         if pg is None:
@@ -1827,8 +1921,7 @@ class Controller:
         for rec in list(self.ready_queue):
             if (rec.state == PENDING
                     and rec.spec.placement_group_id == pg_id):
-                self._fail_task(rec, ValueError(
-                    f"placement group {pg_id} removed while task queued"))
+                self._fail_pg_task(rec, pg_id)
         self.ready_queue.retire_pg_sigs(pg_id)
         for b in pg.bundles:
             self.ready_queue.drop_pool(b.available)

@@ -1,0 +1,99 @@
+"""GCS-style fault tolerance: durable controller metadata per named session.
+
+Reference parity: the reference's GCS survives `gcs_server` restarts by
+re-reading its Redis-backed tables (src/ray/gcs/gcs_server, GCS FT); cluster
+metadata — detached actors, object locations — outlives any one process.
+Single-host translation: `init(session_name=...)` gives the session a
+directory, and the controller journals the state that can meaningfully
+outlive it:
+
+- detached named actors (creation spec + options) — re-registered and
+  restarted from the journal on the next controller with the same session
+  (fresh state, like a reference actor restart).
+- spilled objects (disk path + decode metadata) — restored into the object
+  table, so an object id saved before the crash resolves after it
+  (`ray_tpu.object_ref_from_id`).
+
+The journal is an append-only stream of pickle frames; a torn final record
+(crash mid-write) is dropped at load. Tombstones supersede earlier records,
+so replay is last-write-wins — compaction is a rewrite with the live set.
+"""
+
+import io
+import os
+import pickle
+import threading
+from typing import Dict, List, Optional, Tuple
+
+
+class GcsJournal:
+    def __init__(self, session_dir: str):
+        self.session_dir = session_dir
+        os.makedirs(session_dir, exist_ok=True)
+        self.path = os.path.join(session_dir, "gcs.journal")
+        self._lock = threading.Lock()
+        self._f = open(self.path, "ab")
+
+    def record(self, kind: str, durable: bool = False, **payload):
+        """Append one frame. `durable=True` fsyncs (actor lifecycle — rare
+        and precious); object records only flush, because losing a tail
+        'spilled' frame merely forgets a restorable file (the spill itself
+        is on disk either way) and fsync-per-spill would stall the
+        controller's event loop during memory-pressure spill storms."""
+        frame = pickle.dumps({"kind": kind, **payload},
+                             protocol=pickle.HIGHEST_PROTOCOL)
+        with self._lock:
+            self._f.write(frame)
+            self._f.flush()
+            if durable:
+                os.fsync(self._f.fileno())
+
+    def close(self):
+        with self._lock:
+            self._f.close()
+
+    def load(self) -> List[dict]:
+        records = []
+        try:
+            with open(self.path, "rb") as f:
+                buf = io.BufferedReader(f)
+                while True:
+                    try:
+                        records.append(pickle.load(buf))
+                    except EOFError:
+                        break
+                    except Exception:  # noqa: BLE001 - torn tail frame
+                        break
+        except FileNotFoundError:
+            pass
+        return records
+
+    def compact(self, live_records: List[dict]):
+        """Rewrite the journal with only the live set (atomic replace)."""
+        tmp = self.path + ".tmp"
+        with self._lock:
+            with open(tmp, "wb") as f:
+                for rec in live_records:
+                    f.write(pickle.dumps(rec, protocol=pickle.HIGHEST_PROTOCOL))
+                f.flush()
+                os.fsync(f.fileno())
+            self._f.close()
+            os.replace(tmp, self.path)
+            self._f = open(self.path, "ab")
+
+
+def fold(records: List[dict]) -> Tuple[Dict[str, dict], Dict[str, dict]]:
+    """Replay to the live state: (actors by id, spilled objects by id)."""
+    actors: Dict[str, dict] = {}
+    objects: Dict[str, dict] = {}
+    for rec in records:
+        kind = rec.get("kind")
+        if kind == "detached_actor":
+            actors[rec["actor_id"]] = rec
+        elif kind == "actor_dead":
+            actors.pop(rec["actor_id"], None)
+        elif kind == "spilled":
+            objects[rec["object_id"]] = rec
+        elif kind == "object_gone":
+            objects.pop(rec["object_id"], None)
+    return actors, objects

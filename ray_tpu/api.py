@@ -52,7 +52,7 @@ def is_initialized() -> bool:
 
 def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
          object_store_memory=None, ignore_reinit_error=False, max_workers=None,
-         address=None, **_compat):
+         address=None, session_name=None, **_compat):
     """Start the ray_tpu runtime in this process (the driver), or — with
     `address` — ATTACH to a session another process started (reference:
     ray.init(address="auto") / address=<endpoint>). `address` is the
@@ -94,10 +94,18 @@ def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
         # discoverable by children (workers, submitted job drivers) for
         # init(address="auto") attachment
         os.environ["RAY_TPU_ADDRESS"] = sock
+        # GCS fault tolerance: a NAMED session journals detached actors and
+        # spilled objects to a per-name directory; a later init() with the
+        # same name restores them (ref: GCS FT; see _private/gcs.py)
+        session_dir = None
+        if session_name:
+            session_dir = os.path.join(tempfile.gettempdir(),
+                                       "ray_tpu_sessions", session_name)
         controller = Controller(
             sock, total, job_id=ids.job_id(),
             max_workers=max_workers,
-            store_capacity=capacity)
+            store_capacity=capacity,
+            session_dir=session_dir)
 
         loop = asyncio.new_event_loop()
         started = threading.Event()
@@ -169,6 +177,14 @@ def remote(*args, **options):
     if args:
         raise TypeError("@remote takes keyword options only, e.g. @remote(num_tpus=1)")
     return wrap
+
+
+def object_ref_from_id(object_id: str) -> "ObjectRef":
+    """Rebuild an ObjectRef from its string id (reference:
+    ObjectRef(binary_hex)). The session-restore path: save `ref.id` before a
+    controller restart, re-init with the same `session_name`, and the
+    restored spilled object resolves through this handle."""
+    return ObjectRef(object_id, owned=False)
 
 
 def get(refs, *, timeout=None):

@@ -34,11 +34,14 @@ struct Signature {
   int64_t pool_id = 0;
   std::vector<std::pair<int32_t, double>> demand;
   std::deque<int64_t> fifo;  // pending task sequence numbers, FIFO
+  int64_t live = 0;          // O(1) pending count (push - remove/pop)
+  bool retired = false;      // slot reusable by sq_register_sig
 };
 
 struct SchedQueue {
   std::unordered_map<int64_t, Pool> pools;
   std::vector<Signature> sigs;
+  std::vector<int32_t> free_sigs;  // retired slots for reuse
   // task seq -> (sig index, alive). Removal marks dead; buckets skip dead
   // entries lazily so cancel stays O(1).
   std::unordered_map<int64_t, std::pair<int32_t, bool>> tasks;
@@ -91,7 +94,8 @@ void sq_adjust(void* h, int64_t pool_id, int32_t rid, double delta) {
   q->pools[pool_id].avail[rid] += delta;
 }
 
-// Register a signature (scheduling class). Returns its id.
+// Register a signature (scheduling class). Returns its id, reusing retired
+// slots so placement-group churn doesn't grow the table.
 int32_t sq_register_sig(void* h, int64_t pool_id, const int32_t* rids,
                         const double* amts, int32_t n) {
   auto* q = static_cast<SchedQueue*>(h);
@@ -99,13 +103,40 @@ int32_t sq_register_sig(void* h, int64_t pool_id, const int32_t* rids,
   s.pool_id = pool_id;
   s.demand.reserve(n);
   for (int32_t i = 0; i < n; ++i) s.demand.emplace_back(rids[i], amts[i]);
+  if (!q->free_sigs.empty()) {
+    int32_t id = q->free_sigs.back();
+    q->free_sigs.pop_back();
+    q->sigs[id] = std::move(s);
+    return id;
+  }
   q->sigs.push_back(std::move(s));
   return static_cast<int32_t>(q->sigs.size()) - 1;
+}
+
+// Retire a signature: drop its queued entries and free the slot. Caller
+// guarantees no new pushes for this id until re-registered.
+void sq_retire_sig(void* h, int32_t sig_id) {
+  auto* q = static_cast<SchedQueue*>(h);
+  Signature& sig = q->sigs[sig_id];
+  if (sig.retired) return;
+  for (int64_t seq : sig.fifo) {
+    auto it = q->tasks.find(seq);
+    if (it != q->tasks.end()) {
+      if (it->second.second) --q->pending;
+      q->tasks.erase(it);
+    }
+  }
+  sig.fifo.clear();
+  sig.demand.clear();
+  sig.live = 0;
+  sig.retired = true;
+  q->free_sigs.push_back(sig_id);
 }
 
 void sq_push(void* h, int64_t task_seq, int32_t sig_id) {
   auto* q = static_cast<SchedQueue*>(h);
   q->sigs[sig_id].fifo.push_back(task_seq);
+  q->sigs[sig_id].live += 1;
   q->tasks[task_seq] = {sig_id, true};
   ++q->pending;
 }
@@ -116,20 +147,15 @@ void sq_remove(void* h, int64_t task_seq) {
   auto it = q->tasks.find(task_seq);
   if (it == q->tasks.end() || !it->second.second) return;
   it->second.second = false;
+  q->sigs[it->second.first].live -= 1;
   --q->pending;
 }
 
 int64_t sq_pending(void* h) { return static_cast<SchedQueue*>(h)->pending; }
 
-// Pending count for one signature (live entries only, O(bucket)).
+// Live pending count for one signature — O(1) via the counter.
 int64_t sq_pending_sig(void* h, int32_t sig_id) {
-  auto* q = static_cast<SchedQueue*>(h);
-  int64_t n = 0;
-  for (int64_t seq : q->sigs[sig_id].fifo) {
-    auto it = q->tasks.find(seq);
-    if (it != q->tasks.end() && it->second.second) ++n;
-  }
-  return n;
+  return static_cast<SchedQueue*>(h)->sigs[sig_id].live;
 }
 
 // Earliest pending task whose signature's demand fits its pool, subject to a
@@ -163,7 +189,10 @@ void sq_pop_task(void* h, int64_t task_seq) {
   auto it = q->tasks.find(task_seq);
   if (it == q->tasks.end()) return;
   Signature& sig = q->sigs[it->second.first];
-  if (it->second.second) --q->pending;
+  if (it->second.second) {
+    --q->pending;
+    sig.live -= 1;
+  }
   q->tasks.erase(it);
   for (auto dit = sig.fifo.begin(); dit != sig.fifo.end(); ++dit) {
     if (*dit == task_seq) { sig.fifo.erase(dit); break; }

@@ -83,3 +83,25 @@ def test_remove_pg_fails_queued_tasks(ray_session):
         ray.get(stuck, timeout=60)
     # the scheduler keeps working afterwards
     assert ray.get(queued.remote(), timeout=60) == "ran"
+
+
+def test_pg_churn_bounded_signatures(ray_session):
+    """Creating/removing many PGs must not grow the scheduler's signature
+    table unboundedly (slots retire and get reused)."""
+    from ray_tpu._private import state
+    ray = ray_session
+
+    @ray.remote
+    def f():
+        return 1
+
+    ctrl = state.global_client().controller
+    for _ in range(25):
+        pg = placement_group([{"CPU": 1}], strategy="PACK")
+        strat = PlacementGroupSchedulingStrategy(placement_group=pg)
+        assert ray.get(f.options(scheduling_strategy=strat).remote(),
+                       timeout=60) == 1
+        remove_placement_group(pg)
+    # slots are reused: far fewer live entries than 25 churn rounds
+    live = sum(1 for m in ctrl.ready_queue._sig_meta if not m["dead"])
+    assert live < 15, live
