@@ -1,0 +1,31 @@
+"""Data: lazy datasets, streaming execution, preprocessors.
+
+Run: python examples/03_data_pipeline.py
+"""
+import numpy as np
+
+import ray_tpu as ray
+from ray_tpu import data
+
+ray.init(num_cpus=4)
+
+# a lazy plan: nothing executes until consumption
+ds = (data.range(1000)
+      .map_batches(lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+      .filter(lambda row: row["sq"] % 2 == 0)
+      .random_shuffle(seed=7))
+
+print("schema:", ds.schema())
+print("count:", ds.count())
+print("3 rows:", ds.take(3))
+
+# groupby aggregation
+agg = (data.from_items([{"k": i % 3, "v": float(i)} for i in range(30)])
+       .groupby("k").mean("v"))
+print("group means:", agg.take_all())
+
+# batched iteration feeds training loops (device-feed variant:
+# iter_device_batches double-buffers host->HBM)
+for batch in ds.iter_batches(batch_size=256):
+    print("batch ids:", batch["id"].shape)
+ray.shutdown()

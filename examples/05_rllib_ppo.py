@@ -1,0 +1,25 @@
+"""RLlib: PPO on CartPole with a warmup+cosine LR schedule.
+
+Run: python examples/05_rllib_ppo.py
+"""
+import ray_tpu as ray
+from ray_tpu.rllib.algorithms.ppo import PPOConfig
+
+ray.init(num_cpus=4)
+
+algo = (PPOConfig()
+        .environment("CartPole-v1")
+        .training(lr=3e-4, train_batch_size=256, minibatch_size=128,
+                  num_epochs=2,
+                  lr_schedule={"type": "cosine", "warmup_steps": 5,
+                               "decay_steps": 200})
+        .env_runners(num_env_runners=1, rollout_fragment_length=128)
+        .build())
+
+for i in range(3):
+    result = algo.train()
+    print(f"iter {i}: reward_mean="
+          f"{result.get('episode_return_mean', 0.0):.1f} "
+          f"lr={result['learner']['cur_lr']:.2e}")
+
+ray.shutdown()

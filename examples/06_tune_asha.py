@@ -1,0 +1,30 @@
+"""Tune: hyperparameter search with ASHA early stopping.
+
+Run: python examples/06_tune_asha.py
+"""
+import ray_tpu as ray
+from ray_tpu import tune
+
+ray.init(num_cpus=4)
+
+
+def objective(config):
+    # a fake training curve: converges faster with better lr
+    best = 1.0 / (1.0 + 50 * abs(config["lr"] - 0.01))
+    for step in range(20):
+        score = best * (1 - 0.9 ** (step + 1))
+        tune.report({"score": score, "training_iteration": step + 1})
+
+
+tuner = tune.Tuner(
+    objective,
+    param_space={"lr": tune.loguniform(1e-4, 1e-1),
+                 "batch_size": tune.choice([32, 64, 128])},
+    tune_config=tune.TuneConfig(
+        metric="score", mode="max", num_samples=8,
+        scheduler=tune.ASHAScheduler(max_t=20, grace_period=4)),
+)
+results = tuner.fit()
+best = results.get_best_result()
+print("best config:", best.config, "score:", round(best.metrics["score"], 4))
+ray.shutdown()
