@@ -7,13 +7,15 @@ attention is a CUDA kernel walking a per-sequence page table
 - KV pages live as one pool `[Kh, P, page, D]` in HBM.
 - A block table `[B, max_pages]` maps each sequence's logical pages to pool
   slots; `lengths[B]` counts valid tokens.
-- The kernel runs a grid `(B, Kh, max_pages)` with the block table and
-  lengths as SCALAR-PREFETCH args (pltpu.PrefetchScalarGridSpec): the
-  index_map reads `table[b, p]` to DMA exactly that page into VMEM while the
-  previous page computes — the pallas pipeline does the job of vLLM's manual
-  gather, and pages never materialize contiguously anywhere.
+- The kernel runs a grid `(B, max_pages)` with the block table and lengths
+  as SCALAR-PREFETCH args (pltpu.PrefetchScalarGridSpec): the index_map
+  reads `table[b, p]` to DMA exactly that page (all kv heads of it) into
+  VMEM while the previous page computes — the pallas pipeline does the job
+  of vLLM's manual gather, and pages never materialize contiguously.
 - Online-softmax accumulation across pages (same recurrence as
-  ops/flash_attention.py), GQA folded as [G, D] q-blocks per kv head.
+  ops/flash_attention.py); every kv head folds per step via batched dots
+  ([Kh, G, D] × [Kh, page, D]) so the MXU sees one sizable matmul instead
+  of Kh tiny ones (a per-head grid ran ~2× slower at decode shapes).
 
 Decode is HBM-bandwidth-bound: the win is that only referenced pages move,
 so fragmented long-context batches stream at full bandwidth regardless of
@@ -35,15 +37,20 @@ _LANES = 128
 
 def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                    m_scr, l_scr, acc_scr, *, scale, page_size, max_pages,
-                   gsize):
-    """One (b, kh, p) step: fold page p of sequence b into the accumulator.
+                   gsize, n_kv):
+    """One (b, p) step: fold page p of sequence b into the accumulator for
+    ALL kv heads at once (batched dots keep the MXU busy; a per-head grid
+    left it mostly idle at decode shapes).
 
-    q_ref: [1, G, D] (the kv head's query group), k_ref/v_ref: [1, 1, page, D]
-    (the page the index_map DMA'd via the block table), o_ref: [1, G, D].
+    q_ref: [1, Kh, G, D]; k_ref/v_ref: [Kh, 1, page, D] — every kv head's
+    copy of the one table-selected page; o_ref: [1, Kh, G, D]. Scratch rows
+    are max(Kh*G, 8) — row-wise math pads up to the fp32 sublane tile and
+    the finish slices back down.
     """
     b = pl.program_id(0)
-    p = pl.program_id(2)
+    p = pl.program_id(1)
     seq_len = len_ref[b]
+    h = n_kv * gsize
 
     @pl.when(p == 0)
     def _init():
@@ -55,38 +62,46 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
     # is a placeholder (0), so skip both compute and accumulator updates
     @pl.when(p * page_size < seq_len)
     def _fold():
-        q = q_ref[0, 0].astype(jnp.float32)                    # [G, D]
-        gp = m_scr.shape[0]
-        if gp != q.shape[0]:  # pad tiny GQA groups to the scratch height
-            q = jnp.concatenate(
-                [q, jnp.zeros((gp - q.shape[0], q.shape[1]), q.dtype)])
-        k = k_ref[0, 0].astype(jnp.float32)                    # [page, D]
-        v = v_ref[0, 0].astype(jnp.float32)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32) * scale        # [Gp, page]
+        q = q_ref[0].astype(jnp.float32)                   # [Kh, G, D]
+        k = k_ref[:, 0].astype(jnp.float32)                # [Kh, page, D]
+        v = v_ref[:, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(                           # [Kh, G, page]
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
         cols = p * page_size + jax.lax.broadcasted_iota(
-            jnp.int32, s.shape, 1)
+            jnp.int32, s.shape, 2)
         s = jnp.where(cols < seq_len, s, -jnp.inf)
 
-        m_prev = m_scr[:, :1]                                  # [G, 1]
+        s2 = s.reshape(h, page_size)                       # [H, page]
+        hp = m_scr.shape[0]
+        if hp != h:  # pad tiny head counts up to the sublane tile
+            s2 = jnp.concatenate(
+                [s2, jnp.zeros((hp - h, page_size), s2.dtype)])
+        m_prev = m_scr[:, :1]                              # [Hp, 1]
         l_prev = l_scr[:, :1]
-        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_cur = jnp.max(s2, axis=1, keepdims=True)
         m_new = jnp.maximum(m_prev, m_cur)
         # p==0 always holds >=1 valid token (lengths >= 1 in decode), so
         # m_new > -inf from the first fold on and exp() stays NaN-free
-        pmat = jnp.exp(s - m_new)
+        pmat = jnp.exp(s2 - m_new)                         # [Hp, page]
         alpha = jnp.exp(m_prev - m_new)
         l_new = alpha * l_prev + jnp.sum(pmat, axis=1, keepdims=True)
-        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
-            pmat, v, (((1,), (0,)), ((), ())),
+        pv = jax.lax.dot_general(                          # [Kh, G, D]
+            pmat[:h].reshape(n_kv, gsize, page_size), v,
+            (((2,), (1,)), ((0,), (0,))),
             preferred_element_type=jnp.float32)
+        pv2 = pv.reshape(h, pv.shape[-1])
+        if hp != h:
+            pv2 = jnp.concatenate(
+                [pv2, jnp.zeros((hp - h, pv2.shape[-1]), pv2.dtype)])
+        acc_scr[:] = acc_scr[:] * alpha + pv2
         m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
         l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
 
     @pl.when(p == max_pages - 1)
     def _finish():
-        o_ref[0, 0] = (acc_scr[:gsize] / l_scr[:gsize, :1]).astype(o_ref.dtype)
+        o_ref[0] = (acc_scr[:h] / l_scr[:h, :1]).reshape(
+            n_kv, gsize, acc_scr.shape[-1]).astype(o_ref.dtype)
 
 
 def paged_attention(
@@ -110,10 +125,10 @@ def paged_attention(
     max_pages = block_tables.shape[1]
     scale = scale if scale is not None else 1.0 / math.sqrt(d)
 
-    grid = (b, kh, max_pages)
+    grid = (b, max_pages)
     kernel = functools.partial(
         _decode_kernel, scale=scale, page_size=page_size,
-        max_pages=max_pages, gsize=g)
+        max_pages=max_pages, gsize=g, n_kv=kh)
     q3 = q.reshape(b, kh, g, d)
     out = pl.pallas_call(
         kernel,
@@ -121,21 +136,22 @@ def paged_attention(
             num_scalar_prefetch=2,
             grid=grid,
             in_specs=[
-                pl.BlockSpec((1, 1, g, d),
-                             lambda b_, kh_, p_, tbl, lens: (b_, kh_, 0, 0)),
-                pl.BlockSpec((1, 1, page_size, d),
-                             lambda b_, kh_, p_, tbl, lens:
-                             (kh_, tbl[b_, p_], 0, 0)),
-                pl.BlockSpec((1, 1, page_size, d),
-                             lambda b_, kh_, p_, tbl, lens:
-                             (kh_, tbl[b_, p_], 0, 0)),
+                pl.BlockSpec((1, kh, g, d),
+                             lambda b_, p_, tbl, lens: (b_, 0, 0, 0)),
+                # every kv head's copy of the table-selected page in one block
+                pl.BlockSpec((kh, 1, page_size, d),
+                             lambda b_, p_, tbl, lens:
+                             (0, tbl[b_, p_], 0, 0)),
+                pl.BlockSpec((kh, 1, page_size, d),
+                             lambda b_, p_, tbl, lens:
+                             (0, tbl[b_, p_], 0, 0)),
             ],
             out_specs=pl.BlockSpec(
-                (1, 1, g, d), lambda b_, kh_, p_, tbl, lens: (b_, kh_, 0, 0)),
+                (1, kh, g, d), lambda b_, p_, tbl, lens: (b_, 0, 0, 0)),
             scratch_shapes=[
-                pltpu.VMEM((max(g, 8), _LANES), jnp.float32),
-                pltpu.VMEM((max(g, 8), _LANES), jnp.float32),
-                pltpu.VMEM((max(g, 8), d), jnp.float32),
+                pltpu.VMEM((max(h, 8), _LANES), jnp.float32),
+                pltpu.VMEM((max(h, 8), _LANES), jnp.float32),
+                pltpu.VMEM((max(h, 8), d), jnp.float32),
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, kh, g, d), q.dtype),
