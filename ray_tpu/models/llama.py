@@ -151,8 +151,9 @@ class Attention(nn.Module):
         if isinstance(cache, PagedKVCache):
             # Paged decode/prefill (vLLM memory model, ops/paged_attention):
             # write this layer's K/V into its page slice, then attend. The
-            # cache threads through the block stack; layers touch disjoint
-            # pool slices so every scatter is in-place under donation.
+            # cache threads through the block stack; decode writes use
+            # per-row dynamic_update_slice (in-place on the donated pool —
+            # see write_layer_tokens: the batched scatter COPIED the pool).
             cache = write_layer_tokens(cache, layer_idx, k, v, positions)
             if t == 1:
                 # decode: pallas kernel walks the block table (XLA gather

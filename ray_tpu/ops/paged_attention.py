@@ -248,17 +248,39 @@ def write_tokens(cache: PagedKVCache, k_new: jax.Array, v_new: jax.Array,
 
 def write_layer_tokens(cache: PagedKVCache, layer_idx: int, k_new: jax.Array,
                        v_new: jax.Array, positions: jax.Array) -> PagedKVCache:
-    """Scatter ONE layer's new K/V into its page slice (jit-safe).
+    """Write ONE layer's new K/V into its page slice (jit-safe).
 
     k_new/v_new: [B, T, Kh, D]; positions: [B, T]. Layers touch disjoint
-    pool slices, so the decoder threads the cache through its blocks and
-    each scatter lowers to an in-place update under donation.
+    pool slices, so the decoder threads the cache through its blocks.
+
+    Decode (T == 1) uses per-row dynamic_update_slice: XLA reliably aliases
+    DUS on the donated pool, while the equivalent gather-scatter COPIED the
+    whole pool per layer (measured 28 ms vs 1.1 ms for 16 layers of a 269 MB
+    pool on v5e). Prefill (T > 1) keeps the batched scatter — it runs once
+    per request, not once per generated token.
     """
     bsz, t, kh, d = k_new.shape
+    ps = cache.page_size
+    # match the pool's dtype in both branches: scatter casts silently, but
+    # dynamic_update_slice requires exact dtype agreement
+    k_new = k_new.astype(cache.k_pages.dtype)
+    v_new = v_new.astype(cache.v_pages.dtype)
+    if t == 1:
+        k_pages, v_pages = cache.k_pages, cache.v_pages
+        for b in range(bsz):  # B is static and small; stays one fused program
+            p0 = positions[b, 0]
+            page_id = cache.block_tables[b, p0 // ps]
+            off = p0 % ps
+            start = (layer_idx, 0, page_id, off, 0)
+            k_pages = jax.lax.dynamic_update_slice(
+                k_pages, k_new[b, 0][None, :, None, None, :], start)
+            v_pages = jax.lax.dynamic_update_slice(
+                v_pages, v_new[b, 0][None, :, None, None, :], start)
+        return cache.replace(k_pages=k_pages, v_pages=v_pages)
     pos = positions.reshape(-1)
     rows = jnp.repeat(jnp.arange(bsz), t)
-    page_ids = cache.block_tables[rows, pos // cache.page_size]
-    offs = pos % cache.page_size
+    page_ids = cache.block_tables[rows, pos // ps]
+    offs = pos % ps
     # index tuple (scalar, :, ids, offs): the advanced indices are separated
     # by a slice, so numpy/jax moves the broadcast dim FIRST → values must be
     # [B*T, Kh, D] (contrast write_tokens, whose adjacent indices keep order)
