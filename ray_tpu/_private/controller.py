@@ -345,6 +345,10 @@ class Controller:
         self.dep_waiters: Dict[str, Set[str]] = collections.defaultdict(set)
         self.workers: Dict[str, WorkerConn] = {}
         self.spawning: Dict[str, WorkerConn] = {}
+        # consecutive Popen/OS spawn failures per env_key: transient errors
+        # (fork EAGAIN) retry via _reaper's 1s _schedule; persistent ones
+        # (venv interpreter deleted under us) must still fail fast
+        self._spawn_failures: Dict[Optional[str], int] = {}
         self.actors: Dict[str, ActorRecord] = {}
         self.named_actors: Dict[tuple, str] = {}
         self.pgroups: Dict[str, PlacementGroupRecord] = {}
@@ -750,17 +754,21 @@ class Controller:
         return all(pool.get(k, 0) + 1e-9 >= v for k, v in need.items())
 
     def _claim(self, need: Dict[str, float], pool: Optional[Dict[str, float]]):
+        # pool None = the task's placement group was removed while it ran.
+        # Removal returns only each bundle's UNCLAIMED remainder to the
+        # cluster pool, so in-flight claims settle here: both claim (blocked
+        # task resuming) and release (task finishing) fall back to the
+        # cluster pool, keeping `available` exact instead of transiently
+        # over-committed.
         if pool is None:
-            return  # pool's placement group is gone; nothing to account
+            pool = self.available
         for k, v in need.items():
             pool[k] = pool.get(k, 0) - v
         self.ready_queue.adjust(pool, need, -1)
 
     def _release(self, need: Dict[str, float], pool: Optional[Dict[str, float]]):
         if pool is None:
-            # the PG was removed while this task ran: its bundle's resources
-            # were already returned to the cluster pool wholesale
-            return
+            pool = self.available  # see _claim: settle removed-PG claims
         for k, v in need.items():
             pool[k] = pool.get(k, 0) + v
         self.ready_queue.adjust(pool, need, +1)
@@ -853,6 +861,23 @@ class Controller:
                 return w
         return None
 
+    _SPAWN_FAILURE_LIMIT = 5
+
+    def _note_spawn_failure(self, env_key: Optional[str], err: Exception):
+        """A worker Popen failed (the env itself already built — _env_ready
+        gates every spawn). Transient causes (fork EAGAIN) resolve on the
+        _reaper's next 1s _schedule pass; persistent ones (cached venv
+        interpreter deleted from under us) would otherwise retry silently
+        forever, so after N consecutive failures fail the queued work."""
+        n = self._spawn_failures.get(env_key, 0) + 1
+        self._spawn_failures[env_key] = n
+        print(f"[controller] worker spawn failed for env {env_key!r} "
+              f"({n}/{self._SPAWN_FAILURE_LIMIT}): {err!r}", file=sys.stderr)
+        if n >= self._SPAWN_FAILURE_LIMIT:
+            self._spawn_failures.pop(env_key, None)
+            self._fail_env_tasks(env_key, exc.RuntimeEnvSetupError(
+                f"worker spawn failed {n} times in a row: {err}"))
+
     def _fail_env_tasks(self, env_key: Optional[str], err: Exception):
         """Runtime env build failed: fail every queued task/actor needing it."""
         for rec in list(self.ready_queue):
@@ -922,9 +947,10 @@ class Controller:
                 try:
                     self._spawn_worker(env_key=env_key,
                                        runtime_env=env_specs.get(env_key))
-                except Exception as e:  # noqa: BLE001 - env build failure
-                    self._fail_env_tasks(env_key, e)
+                except Exception as e:  # noqa: BLE001
+                    self._note_spawn_failure(env_key, e)
                     break
+                self._spawn_failures.pop(env_key, None)
                 headroom -= 1
         # TPU pool-workers: one persistent worker serves the chip queue (a
         # second process can't initialize the platform while the first
@@ -948,7 +974,9 @@ class Controller:
                 self._spawn_worker(tpu_capable=True, env_key=env_key,
                                    runtime_env=env_specs.get(env_key))
             except Exception as e:  # noqa: BLE001
-                self._fail_env_tasks(env_key, e)
+                self._note_spawn_failure(env_key, e)
+            else:
+                self._spawn_failures.pop(env_key, None)
             break
 
     # ------------------------------------------------------------ autoscaler
@@ -1925,7 +1953,11 @@ class Controller:
         self.ready_queue.retire_pg_sigs(pg_id)
         for b in pg.bundles:
             self.ready_queue.drop_pool(b.available)
-            self._release(b.resources, self.available)
+            # Return only what no running task holds; each still-running PG
+            # task settles its own claim into the cluster pool when it
+            # finishes (_release with pool=None). Releasing b.resources here
+            # would over-commit `available` until those tasks drain.
+            self._release(b.available, self.available)
 
     # ------------------------------------------------------------------- state
     def state_snapshot(self, kind: str):

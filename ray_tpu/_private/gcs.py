@@ -25,11 +25,19 @@ import pickle
 import threading
 from typing import Dict, List, Optional, Tuple
 
+from . import paths
+
 
 class GcsJournal:
     def __init__(self, session_dir: str):
         self.session_dir = session_dir
         os.makedirs(session_dir, exist_ok=True)
+        # The journal is unpickled at restore: never load one another local
+        # user could have planted. Session dirs live under the per-user 0700
+        # root (_private/paths.py), but verify this dir too (symlink, owner,
+        # group/world access) in case a custom session_dir pointed somewhere
+        # shared.
+        paths.verify_private_dir(session_dir)
         self.path = os.path.join(session_dir, "gcs.journal")
         self._lock = threading.Lock()
         self._f = open(self.path, "ab")

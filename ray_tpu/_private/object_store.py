@@ -19,7 +19,9 @@ from multiprocessing import shared_memory, resource_tracker
 
 from . import serialization
 
-_SPILL_DIR = "/tmp/ray_tpu_spill"
+def _spill_dir() -> str:
+    from . import paths
+    return paths.subdir("spill")
 
 # The stdlib resource_tracker assumes whoever creates a segment owns cleanup;
 # our segments outlive their creator (controller manages lifetime), which
@@ -216,8 +218,7 @@ class StoreClient:
     # -- spilling ------------------------------------------------------------
     def spill(self, object_id: str) -> str:
         """Copy object to disk and free it. Returns the spill path."""
-        os.makedirs(_SPILL_DIR, exist_ok=True)
-        path = os.path.join(_SPILL_DIR, seg_name(object_id))
+        path = os.path.join(_spill_dir(), seg_name(object_id))
         data = self.read_raw(object_id)
         with open(path, "wb") as f:
             f.write(data)

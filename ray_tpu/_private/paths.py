@@ -1,0 +1,69 @@
+"""Per-user scratch roots for session state, caches, and sockets.
+
+Everything ray_tpu persists outside the repo (GCS journals, runtime-env
+venvs, spill files, job logs, the driver socket) lives under ONE per-user
+0700 directory. Rationale: the GCS journal is unpickled at restore and a
+cached venv's interpreter is exec'd by workers — on a multi-user host a
+world-writable shared path (/tmp/ray_tpu_sessions/...) would let another
+local user pre-plant either one (arbitrary code). The reference scopes its
+session tree the same way (/tmp/ray/session_* owned by the starting user).
+
+`XDG_RUNTIME_DIR` is preferred when set: it is per-user, 0700, and tmpfs on
+systemd hosts. Otherwise `<tmpdir>/ray_tpu_<uid>` with enforced ownership.
+"""
+
+import os
+import stat
+import tempfile
+
+_checked: dict = {}
+
+
+def user_tmp_root() -> str:
+    """Return the per-user 0700 scratch root, creating and verifying it.
+
+    Raises RuntimeError if the path exists but is owned by someone else or
+    is group/world accessible — never silently trust a pre-planted dir.
+    """
+    base = os.environ.get("XDG_RUNTIME_DIR")
+    if base and os.path.isdir(base):
+        root = os.path.join(base, "ray_tpu")
+    else:
+        root = os.path.join(tempfile.gettempdir(), f"ray_tpu_{os.getuid()}")
+    if _checked.get(root):
+        return root
+    try:
+        os.mkdir(root, 0o700)
+    except FileExistsError:
+        pass
+    verify_private_dir(root)
+    _checked[root] = True
+    return root
+
+
+def verify_private_dir(path: str) -> None:
+    """Require `path` to be a real directory owned by us and private.
+
+    Used for any directory whose contents get unpickled or exec'd (GCS
+    journals, runtime-env venvs): a symlink, foreign owner, or group/world
+    access would let another local user substitute those contents.
+    """
+    st = os.lstat(path)
+    if not stat.S_ISDIR(st.st_mode):
+        raise RuntimeError(f"{path!r} is not a directory (or is a symlink) "
+                           f"— refusing to trust it")
+    if st.st_uid != os.getuid():
+        raise RuntimeError(
+            f"{path!r} is owned by uid {st.st_uid}, not {os.getuid()} — "
+            f"refusing to trust it (remove it or set XDG_RUNTIME_DIR)")
+    if st.st_mode & 0o077:
+        # Loose perms on a dir we own (e.g. created by an older version):
+        # tighten rather than fail.
+        os.chmod(path, 0o700)
+
+
+def subdir(*parts: str) -> str:
+    """A subdirectory under the verified per-user root (created)."""
+    p = os.path.join(user_tmp_root(), *parts)
+    os.makedirs(p, exist_ok=True)
+    return p

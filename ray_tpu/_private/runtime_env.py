@@ -29,9 +29,10 @@ import os
 import shutil
 import subprocess
 import sys
-import tempfile
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
+
+from . import paths
 
 _IGNORED_KEYS = {"_tpu_ids", "_content_key"}
 _SUPPORTED = {"env_vars", "py_modules", "working_dir", "pip"}
@@ -123,8 +124,9 @@ class RuntimeEnvManager:
     """
 
     def __init__(self, cache_root: Optional[str] = None):
-        self.cache_root = cache_root or os.path.join(
-            tempfile.gettempdir(), "ray_tpu_runtime_envs")
+        # Per-user 0700 root: workers exec the cached venv's interpreter,
+        # so the cache must not be plantable by other local users.
+        self.cache_root = cache_root or paths.subdir("runtime_envs")
         self._contexts: Dict[str, RuntimeEnvContext] = {}
 
     def is_built(self, key: Optional[str]) -> bool:

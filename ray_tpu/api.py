@@ -10,10 +10,9 @@ import asyncio
 import atexit
 import inspect
 import os
-import tempfile
 import threading
 
-from ._private import ids, state
+from ._private import ids, paths, state
 from ._private.client import DriverClient, WorkerClient
 from ._private.controller import Controller, DEFAULT_CAPACITY
 from ._private.object_ref import ObjectRef, ObjectRefGenerator
@@ -85,7 +84,8 @@ def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
         if ntpu:
             total["TPU"] = float(ntpu)
         total.setdefault("memory", 64 << 30)
-        sock = os.path.join(tempfile.gettempdir(), f"rtpu-{os.getpid()}-{ids.new_id('s')[-8:]}.sock")
+        sock = os.path.join(paths.user_tmp_root(),
+                            f"rtpu-{os.getpid()}-{ids.new_id('s')[-8:]}.sock")
         # publish the arena name BEFORE the controller builds its store;
         # workers inherit the env and attach to the same C++ shm arena
         capacity = object_store_memory or DEFAULT_CAPACITY
@@ -99,8 +99,14 @@ def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
         # same name restores them (ref: GCS FT; see _private/gcs.py)
         session_dir = None
         if session_name:
-            session_dir = os.path.join(tempfile.gettempdir(),
-                                       "ray_tpu_sessions", session_name)
+            # a bare name, not a path: keeps the journal under the verified
+            # per-user root (session_name="/shared/x" or "../x" would escape
+            # the 0700 boundary the journal's trust model depends on)
+            if (os.sep in session_name or session_name in (".", "..")
+                    or (os.altsep and os.altsep in session_name)):
+                raise ValueError(
+                    f"session_name must be a plain name, got {session_name!r}")
+            session_dir = os.path.join(paths.subdir("sessions"), session_name)
         controller = Controller(
             sock, total, job_id=ids.job_id(),
             max_workers=max_workers,

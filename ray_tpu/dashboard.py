@@ -58,7 +58,7 @@ class DashboardActor:
                     req = await read_http_request(reader)
                 except _BadRequest as e:
                     await write_http_response(writer, Response(
-                        str(e).encode(), 400, media_type="text/plain"))
+                        str(e).encode(), e.status, media_type="text/plain"))
                     break
                 if req is None:
                     break

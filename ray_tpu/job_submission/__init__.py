@@ -20,7 +20,6 @@ import json
 import os
 import signal
 import subprocess
-import tempfile
 import time
 import uuid
 from dataclasses import asdict, dataclass, field
@@ -64,9 +63,8 @@ class _JobManager:
     def __init__(self):
         self._jobs: Dict[str, JobInfo] = {}
         self._procs: Dict[str, subprocess.Popen] = {}
-        self._dir = os.path.join(tempfile.gettempdir(),
-                                 f"rtpu-jobs-{os.getpid()}")
-        os.makedirs(self._dir, exist_ok=True)
+        from ray_tpu._private import paths
+        self._dir = paths.subdir(f"jobs-{os.getpid()}")
 
     def submit(self, entrypoint: str, submission_id: Optional[str] = None,
                env_vars: Optional[Dict[str, str]] = None,

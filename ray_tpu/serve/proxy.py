@@ -16,6 +16,7 @@ the client sends `Accept: text/event-stream`, the response is streamed as SSE
 import asyncio
 import inspect
 import json
+import os
 import time
 import traceback
 from typing import Dict, Optional, Tuple
@@ -66,19 +67,29 @@ class Response:
 
 _STATUS_TEXT = {200: "OK", 204: "No Content", 400: "Bad Request",
                 404: "Not Found", 405: "Method Not Allowed",
-                411: "Length Required", 500: "Internal Server Error",
-                503: "Service Unavailable"}
+                411: "Length Required", 413: "Payload Too Large",
+                500: "Internal Server Error", 503: "Service Unavailable"}
+
+# Bodies buffer in proxy/dashboard memory before dispatch; without a cap a
+# client can stream unbounded chunks into the process. Same ballpark as
+# common ingress defaults; override per-process via env.
+MAX_BODY_BYTES = int(os.environ.get("RAY_TPU_MAX_HTTP_BODY", 100 << 20))
 
 
 class _BadRequest(Exception):
-    pass
+    status = 400
 
 
-async def _read_chunked_body(reader) -> bytes:
+class _PayloadTooLarge(_BadRequest):
+    status = 413
+
+
+async def _read_chunked_body(reader, max_bytes: int) -> bytes:
     """Decode a Transfer-Encoding: chunked body (size-hex CRLF data CRLF ...
      0 CRLF trailers CRLF). Ref contrast: the reference proxy gets this for
     free from uvicorn's h11; here the decoder is explicit."""
     chunks = []
+    total = 0
     while True:
         size_line = await reader.readline()
         if not size_line:
@@ -89,6 +100,9 @@ async def _read_chunked_body(reader) -> bytes:
             raise _BadRequest("invalid chunk size") from None
         if size == 0:
             break
+        total += size
+        if total > max_bytes:
+            raise _PayloadTooLarge(f"chunked body exceeds {max_bytes} bytes")
         chunks.append(await reader.readexactly(size))
         if await reader.readexactly(2) != b"\r\n":
             raise _BadRequest("malformed chunk terminator")
@@ -118,7 +132,7 @@ async def read_http_request(reader) -> Optional[Request]:
             k, v = hline.decode("latin1").split(":", 1)
             headers[k.strip().lower()] = v.strip()
     if "chunked" in headers.get("transfer-encoding", "").lower():
-        body = await _read_chunked_body(reader)
+        body = await _read_chunked_body(reader, MAX_BODY_BYTES)
         parts = urlsplit(target)
         return Request(method.upper(), unquote(parts.path), parts.query,
                        headers, body)
@@ -128,6 +142,9 @@ async def read_http_request(reader) -> Optional[Request]:
             raise ValueError(length)
     except ValueError:
         raise _BadRequest("invalid Content-Length") from None
+    if length > MAX_BODY_BYTES:
+        raise _PayloadTooLarge(f"body of {length} bytes exceeds "
+                               f"{MAX_BODY_BYTES}")
     body = await reader.readexactly(length) if length else b""
     parts = urlsplit(target)
     return Request(method.upper(), unquote(parts.path), parts.query,
@@ -270,7 +287,7 @@ class ProxyActor:
             req = await self._read_request(reader)
         except _BadRequest as e:
             await self._write_plain(writer, Response(
-                str(e).encode(), 400, media_type="text/plain"))
+                str(e).encode(), e.status, media_type="text/plain"))
             return False
         if req is None:
             return False

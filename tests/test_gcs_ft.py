@@ -6,6 +6,7 @@ crash."""
 
 import json
 import os
+import pickle
 import subprocess
 import sys
 import textwrap
@@ -112,6 +113,8 @@ def test_torn_tail_frame_dropped():
     j.record("spilled", object_id="o1", path="/x", size=1, meta_len=0)
     j.close()
     with open(os.path.join(d, "gcs.journal"), "ab") as f:
-        f.write(b"\\x80\\x05TORN")  # half a pickle frame (crash mid-write)
+        # a genuinely half-written pickle frame (crash mid-write): real
+        # frame bytes truncated, not a printable stand-in
+        f.write(pickle.dumps({"kind": "spilled", "object_id": "o2"})[:7])
     _actors, objects = fold(GcsJournal(d).load())
     assert list(objects) == ["o1"]

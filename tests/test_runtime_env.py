@@ -141,7 +141,7 @@ def test_pip_venv_local_package(ray_session, tmp_path):
     }).remote(), timeout=180)
     assert ans == 4242
     # the task really ran under the per-env venv interpreter
-    assert "ray_tpu_runtime_envs" in prefix
+    assert f"{os.sep}runtime_envs{os.sep}" in prefix
 
 
 def test_edited_py_module_restaged_on_resubmit(ray_session, tmp_path):

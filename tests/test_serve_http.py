@@ -188,3 +188,53 @@ def test_http_chunked_request_body(serve_app):
     assert head.startswith(b"HTTP/1.1 200")
     out = json.loads(body)
     assert out == {"n": 19, "text": "hello chunked world"}
+
+
+def test_http_body_size_cap(serve_app, monkeypatch):
+    """An oversized body is rejected with 413 instead of buffered into proxy
+    memory (advisor r3: unbounded chunked uploads)."""
+    # the proxy runs in its own worker process and reads the cap from the
+    # env at import; workers inherit the driver's environ
+    monkeypatch.setenv("RAY_TPU_MAX_HTTP_BODY", "1024")
+    serve = serve_app
+
+    @serve.deployment
+    def echo(request):
+        return {"n": len(request.body)}
+
+    serve.run(echo.bind(), name="cap", route_prefix="/cap")
+    port = serve.start(http_options={"port": 0})
+
+    import socket
+
+    def _roundtrip(raw: bytes) -> bytes:
+        s = socket.create_connection(("127.0.0.1", port), timeout=30)
+        s.sendall(raw)
+        s.settimeout(30)
+        resp = b""
+        while b"\r\n\r\n" not in resp:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            resp += chunk
+        s.close()
+        return resp
+
+    # Content-Length over the cap: rejected before reading the body
+    resp = _roundtrip(b"POST /cap HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: 99999\r\n\r\n")
+    assert resp.startswith(b"HTTP/1.1 413")
+
+    # chunked body over the cap: rejected mid-stream
+    big = b"x" * 600
+    payload = b"".join(
+        hex(len(c))[2:].encode() + b"\r\n" + c + b"\r\n" for c in [big, big])
+    resp = _roundtrip(b"POST /cap HTTP/1.1\r\nHost: x\r\n"
+                      b"Transfer-Encoding: chunked\r\n\r\n" + payload +
+                      b"0\r\n\r\n")
+    assert resp.startswith(b"HTTP/1.1 413")
+
+    # an in-budget request still works
+    resp = _roundtrip(b"POST /cap HTTP/1.1\r\nHost: x\r\n"
+                      b"Content-Length: 3\r\n\r\nabc")
+    assert resp.startswith(b"HTTP/1.1 200")
