@@ -42,25 +42,32 @@ def main():
     tok = jnp.ones((B, 1), jnp.int32)
 
     def bench(step, cache):
+        t0 = time.perf_counter()
         cache, logits = step(params, cache, tok)
         float(jnp.sum(logits))  # host-fetch sync (axon: see module doc)
+        compile_s = time.perf_counter() - t0
         t0 = time.perf_counter()
         for _ in range(STEPS):
             cache, logits = step(params, cache, tok)
         float(jnp.sum(logits))
         dt = time.perf_counter() - t0
-        return B * STEPS / dt, dt / STEPS * 1e3
+        return B * STEPS / dt, dt / STEPS * 1e3, compile_s
 
     @functools.partial(jax.jit, donate_argnums=(1,))
     def step(p, cache, t):
         logits, cache = model.apply(p, t, cache=cache)
         return cache, logits
 
+    import json
+    out = {"B": B, "smax": SMAX, "page": PAGE, "steps": STEPS}
     if not os.environ.get("SKIP_DENSE"):
         dense = KVCache.init(cfg, B, SMAX).replace(
             length=jnp.full((B,), 64, jnp.int32))
-        tps, ms = bench(step, dense)
-        print(f"dense: {tps:,.0f} tok/s ({ms:.1f} ms/step, B={B})")
+        tps, ms, comp = bench(step, dense)
+        print(f"dense: {tps:,.0f} tok/s ({ms:.1f} ms/step, B={B}, "
+              f"compile {comp:.1f}s)")
+        out.update(dense_tps=round(tps), dense_ms=round(ms, 2),
+                   dense_compile_s=round(comp, 1))
 
     max_pages = SMAX // PAGE
     mgr = PageManager(B * max_pages + 1, PAGE, B, max_pages)
@@ -70,8 +77,12 @@ def main():
         PAGE, B, max_pages, dtype=cfg.dtype).replace(
             block_tables=jnp.asarray(rows, jnp.int32),
             lengths=jnp.full((B,), 64, jnp.int32))
-    tps, ms = bench(step, paged)
-    print(f"paged: {tps:,.0f} tok/s ({ms:.1f} ms/step, B={B}, page={PAGE})")
+    tps, ms, comp = bench(step, paged)
+    print(f"paged: {tps:,.0f} tok/s ({ms:.1f} ms/step, B={B}, page={PAGE}, "
+          f"compile {comp:.1f}s)")
+    out.update(paged_tps=round(tps), paged_ms=round(ms, 2),
+               paged_compile_s=round(comp, 1))
+    print("JSON:", json.dumps(out))
 
 
 if __name__ == "__main__":

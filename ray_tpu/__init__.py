@@ -15,6 +15,7 @@ from .actor import ActorClass, ActorHandle, method, exit_actor
 from .api import (
     available_resources,
     cancel,
+    cluster_address,
     cluster_resources,
     get,
     get_actor,
@@ -37,7 +38,7 @@ __all__ = [
     "__version__",
     "ActorClass", "ActorHandle", "ObjectRef", "ObjectRefGenerator",
     "DynamicObjectRefGenerator", "RemoteFunction",
-    "available_resources", "cancel", "cluster_resources", "exceptions",
+    "available_resources", "cancel", "cluster_address", "cluster_resources", "exceptions",
     "exit_actor", "get", "get_actor", "get_runtime_context", "get_tpu_ids",
     "init", "is_initialized", "kill", "method", "nodes", "object_ref_from_id", "put", "remote",
     "shutdown", "timeline", "wait",

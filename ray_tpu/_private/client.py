@@ -150,14 +150,18 @@ class DriverClient(BaseClient):
             pass
 
     def resources(self):
-        return (self._call_soon(lambda: dict(self.controller.total)),
-                self._call_soon(lambda: dict(self.controller.available)))
+        return (self._call_soon(self.controller.res_total),
+                self._call_soon(self.controller.res_available))
 
     def request_resources(self, num_cpus=None, bundles=None):
         return self._call_soon(self.controller.request_resources, num_cpus, bundles)
 
     def autoscaler_status(self):
         return self._call_soon(self.controller.autoscaler_status)
+
+    def set_node_provider(self, provider, max_nodes=4):
+        return self._call_soon(self.controller.set_node_provider, provider,
+                               max_nodes)
 
     def object_sizes(self, oids):
         """Registered byte sizes (0 for unknown ids) — cheap metadata read used

@@ -1,0 +1,566 @@
+"""Cross-host control plane: a head controller federating worker-node agents.
+
+Reference parity: the reference cluster is raylets federated through the GCS
+(src/ray/raylet/node_manager.h:142 NodeManager, node table in
+src/ray/gcs/gcs_server/gcs_node_manager.cc), with the object manager moving
+objects between per-node plasma stores on demand
+(src/ray/object_manager/object_manager.cc Push/Pull). TPU-first re-design:
+
+- The HEAD is the driver's in-process controller (api.init(cluster_port=N)).
+  It owns the cluster-wide object table, the actor/task registries, naming,
+  and placement. There is no separate GCS process — the head IS the GCS,
+  which is the right cut for the TPU topology this serves (one driver host
+  orchestrating CPU-actor fleets that feed TPU hosts; SPMD compute scales
+  through jax.distributed on the data plane, not through this plane).
+- A NODE is `python -m ray_tpu._private.node_main --address head:port`: a
+  full local Controller (own shm arena, own worker pool, own scheduler) plus
+  one TCP uplink. A node looks like a single fat worker to the head and like
+  a normal controller to its local workers, so every single-host mechanism
+  (runtime envs, streams, actor restarts, spilling) works unchanged on it.
+- Placement happens once, at the head, when a task's deps are satisfied:
+  DEFAULT = local-first with overflow to the least-loaded fitting node;
+  SPREAD = round-robin across fitting nodes; NodeAffinity = that node (hard
+  fails if gone, soft falls back). Placement groups stay head-local.
+- Objects move lazily, pull-based, like the reference: a forwarded task
+  ships its dep bytes with the spec (push-on-forward); results stay in the
+  producing node's store and the head records location "remote:<node_id>",
+  pulling bytes only when something actually `get`s them.
+- A worker ON a node submits work to its local controller; work the node
+  cannot or should not place (infeasible there, SPREAD/NodeAffinity
+  strategies, methods on actors living elsewhere) spills UP to the head,
+  which places it cluster-wide — the analog of raylet spillback scheduling.
+
+Wire: the same length-prefixed pickle framing as the worker protocol, over
+TCP, with bidirectional request/response multiplexing. An optional shared
+secret (RAY_TPU_CLUSTER_TOKEN) gates node registration; the trust model
+otherwise matches the reference's in-cluster gRPC (flat trusted network).
+"""
+
+import asyncio
+import os
+import socket as _socket
+import sys
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set
+
+from .. import exceptions as exc
+from . import protocol
+from .task_spec import TaskSpec
+
+HEARTBEAT_S = 1.0
+
+
+def cluster_token() -> str:
+    return os.environ.get("RAY_TPU_CLUSTER_TOKEN", "")
+
+
+@dataclass
+class NodeConn:
+    """Head-side record of one registered worker node (ref: GcsNodeInfo)."""
+
+    node_id: str
+    writer: asyncio.StreamWriter
+    resources: Dict[str, float]
+    available: Dict[str, float]      # optimistic mirror, trued by heartbeats
+    host: str = ""
+    pid: int = 0
+    inflight: Dict[str, object] = field(default_factory=dict)  # task_id -> rec
+    actors: Set[str] = field(default_factory=set)
+    alive: bool = True
+    last_seen: float = field(default_factory=time.time)
+
+
+class ClusterServer:
+    """Runs inside the head controller's event loop."""
+
+    def __init__(self, controller):
+        self.c = controller
+        self.nodes: Dict[str, NodeConn] = {}
+        self.port: Optional[int] = None
+        self.host: str = "127.0.0.1"
+        self._server = None
+        self._reqs: Dict[int, asyncio.Future] = {}
+        self._req_counter = 0
+        self._rr = 0  # SPREAD round-robin cursor
+
+    async def start(self, port: int, host: str = None):
+        # loopback by default: binding all interfaces is opt-in
+        # (RAY_TPU_CLUSTER_HOST=0.0.0.0) and then a cluster token is
+        # mandatory — the wire is pickle, so an open unauthenticated port
+        # would hand code execution to any network peer.
+        host = host or os.environ.get("RAY_TPU_CLUSTER_HOST", "127.0.0.1")
+        if host not in ("127.0.0.1", "localhost", "::1") and not cluster_token():
+            raise ValueError(
+                f"refusing to bind cluster port on {host!r} without "
+                f"RAY_TPU_CLUSTER_TOKEN set (pickle wire protocol)")
+        self._server = await asyncio.start_server(self._on_node, host, port)
+        self.port = self._server.sockets[0].getsockname()[1]
+        self.host = (_socket.gethostname()
+                     if host not in ("127.0.0.1", "localhost", "::1")
+                     else "127.0.0.1")
+
+    def close(self):
+        if self._server is not None:
+            self._server.close()
+        for node in self.nodes.values():
+            try:
+                node.writer.close()
+            except Exception:  # noqa: BLE001
+                pass
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    # ----------------------------------------------------------- connections
+    async def _on_node(self, reader, writer):
+        # auth happens on a PLAINTEXT hello line BEFORE any pickle frame is
+        # read — the framing is pickle, and unpickling pre-auth bytes would
+        # hand code execution to whoever reached the port
+        import hmac
+        try:
+            hello = await asyncio.wait_for(reader.readline(), timeout=10)
+        except (asyncio.TimeoutError, OSError):
+            writer.close()
+            return
+        expect = f"RTPU1 {cluster_token()}\n".encode()
+        if not hmac.compare_digest(hello, expect):
+            try:
+                writer.write(b"DENIED bad cluster token\n")
+                await writer.drain()
+            except OSError:
+                pass
+            writer.close()
+            return
+        msg = await protocol.aread_msg(reader)
+        if msg is None or msg[0] != "register_node":
+            writer.close()
+            return
+        p = msg[1]
+        node = NodeConn(node_id=p["node_id"], writer=writer,
+                        resources=dict(p["resources"]),
+                        available=dict(p["resources"]),
+                        host=p.get("host", ""), pid=p.get("pid", 0))
+        self.nodes[node.node_id] = node
+        protocol.awrite_msg(writer, "register_ok", head_node_id=self.c.node_id)
+        self.c._schedule()
+        try:
+            while True:
+                msg = await protocol.aread_msg(reader)
+                if msg is None:
+                    break
+                await self._handle_node_msg(node, msg[0], msg[1])
+        finally:
+            node.alive = False
+            self.nodes.pop(node.node_id, None)
+            if not self.c._shutdown:
+                self._on_node_dead(node)
+
+    async def _handle_node_msg(self, node: NodeConn, kind: str, p: dict):
+        c = self.c
+        if kind == "task_result":
+            self._on_task_result(node, p)
+        elif kind == "stats":
+            node.available = dict(p["available"])
+            node.last_seen = time.time()
+            c._schedule()
+        elif kind == "resp":
+            fut = self._reqs.pop(p.pop("req_id"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(p)
+        elif kind == "fetch_object":
+            # a node worker needs an object the node doesn't have: serve it
+            # from the head store, or pull it from whichever node has it
+            c.loop.create_task(self._serve_fetch(node, p))
+        elif kind == "up_submit":
+            c.loop.create_task(self._serve_up_submit(node, p))
+        elif kind == "up_lookup_actor":
+            try:
+                aid = c.lookup_actor(p["name"], p.get("namespace"))
+                self._node_reply(node, p["req_id"], actor_id=aid)
+            except ValueError as e:
+                self._node_reply(node, p["req_id"], error=e)
+        elif kind == "up_kill_actor":
+            c.kill_actor(p["actor_id"], no_restart=p.get("no_restart", True))
+            self._node_reply(node, p["req_id"], ok=True)
+        elif kind == "up_cancel":
+            c.cancel(p["task_id"], force=p.get("force", False))
+            self._node_reply(node, p["req_id"], ok=True)
+        elif kind == "actor_dead":
+            actor = c.actors.get(p["actor_id"])
+            node.actors.discard(p["actor_id"])
+            if actor is not None:
+                c._fail_actor(actor, p.get("reason", "died on remote node"),
+                              allow_restart=False)
+
+    def _node_reply(self, node: NodeConn, req_id, **payload):
+        protocol.awrite_msg(node.writer, "resp", req_id=req_id, **payload)
+
+    def _rpc(self, node: NodeConn, kind: str, **payload) -> asyncio.Future:
+        self._req_counter += 1
+        req_id = self._req_counter
+        fut = self.c.loop.create_future()
+        self._reqs[req_id] = fut
+        protocol.awrite_msg(node.writer, kind, req_id=req_id, **payload)
+        return fut
+
+    # ------------------------------------------------------------- placement
+    def place(self, rec) -> Optional[NodeConn]:
+        """Pick a node for a deps-ready task. None = run on the head.
+
+        Called from _enqueue_ready; placement-group work, streaming
+        generators, and actor methods never reach here (PGs are head-local;
+        streams need the head's stream table; methods follow their actor).
+        """
+        spec: TaskSpec = rec.spec
+        strat = spec.scheduling_strategy
+        live = [n for n in self.nodes.values() if n.alive]
+        from ..util.scheduling_strategies import NodeAffinitySchedulingStrategy
+        if isinstance(strat, NodeAffinitySchedulingStrategy):
+            if strat.node_id == self.c.node_id:
+                return None
+            node = self.nodes.get(strat.node_id)
+            if node is not None and node.alive:
+                return node
+            if strat.soft:
+                return self._default_place(spec, live)
+            self.c._fail_task(rec, ValueError(
+                f"NodeAffinity(hard) node {strat.node_id!r} is not alive"))
+            return None
+        if strat == "SPREAD":
+            # round-robin over head + fitting nodes (ref: SPREAD is
+            # best-effort dispersal, scheduling_policy.cc)
+            slots = [None] + [n for n in live
+                              if self._fits(spec.resources, n.available)]
+            if not slots:
+                return None
+            self._rr += 1
+            return slots[self._rr % len(slots)]
+        return self._default_place(spec, live)
+
+    def _head_free(self) -> Dict[str, float]:
+        """Head resources not yet spoken for: `available` minus the demand
+        of locally-queued PENDING tasks (claims happen at dispatch, so the
+        raw pool would let every task in one burst 'fit locally' and never
+        overflow to a node)."""
+        free = dict(self.c.available)
+        for rec in self.c.ready_queue:
+            if rec.state == "PENDING":
+                for k, v in rec.spec.resources.items():
+                    free[k] = free.get(k, 0) - v
+        return free
+
+    def _default_place(self, spec: TaskSpec, live: List[NodeConn]):
+        """Local if it fits now; else the least-loaded node where it fits
+        now; else local if EVER feasible locally; else any node where it is
+        feasible (queue there)."""
+        res = spec.resources
+        if self._fits(res, self._head_free()):
+            return None
+        fitting = [n for n in live if self._fits(res, n.available)]
+        if fitting:
+            return max(fitting, key=lambda n: n.available.get("CPU", 0.0))
+        if self._fits(res, self.c.total):
+            return None
+        feasible = [n for n in live if self._fits(res, n.resources)]
+        return feasible[0] if feasible else None
+
+    @staticmethod
+    def _fits(need: Dict[str, float], pool: Dict[str, float]) -> bool:
+        return all(pool.get(k, 0) + 1e-9 >= v for k, v in need.items())
+
+    def feasible_somewhere(self, res: Dict[str, float]) -> bool:
+        return (self._fits(res, self.c.total)
+                or any(self._fits(res, n.resources)
+                       for n in self.nodes.values() if n.alive))
+
+    # ------------------------------------------------------------ forwarding
+    def forward_task(self, rec, node: NodeConn, options=None):
+        """Hand a deps-ready task (or actor creation) to `node`. Claims the
+        head's optimistic mirror immediately (sync, so one _schedule pass
+        cannot double-place), then ships spec+deps asynchronously."""
+        spec: TaskSpec = rec.spec
+        for k, v in spec.resources.items():
+            node.available[k] = node.available.get(k, 0) - v
+        rec.state = "RUNNING"
+        rec.node_id = node.node_id
+        rec.ts_start = time.time()
+        node.inflight[spec.task_id] = rec
+        if spec.is_actor_creation:
+            actor = self.c.actors.get(spec.actor_id)
+            if actor is not None:
+                actor.node_id = node.node_id
+                node.actors.add(spec.actor_id)
+        self.c.loop.create_task(self._ship(rec, node, options))
+
+    def forward_method(self, rec, node: NodeConn):
+        """Actor method call → the node hosting the actor. No resource claim
+        (methods run inside the actor's standing allocation, node-side)."""
+        rec.state = "RUNNING"
+        rec.node_id = node.node_id
+        rec.ts_start = time.time()
+        node.inflight[rec.spec.task_id] = rec
+        self.c.loop.create_task(self._ship(rec, node, None))
+
+    async def _ship(self, rec, node: NodeConn, options):
+        spec: TaskSpec = rec.spec
+        try:
+            deps = await self._collect_deps(spec, node)
+        except Exception as e:  # noqa: BLE001 - dep pull failed
+            node.inflight.pop(spec.task_id, None)
+            self._release_mirror(node, spec)
+            if spec.actor_id and not spec.is_actor_creation:
+                actor = self.c.actors.get(spec.actor_id)
+                if actor is not None:
+                    actor.in_flight.discard(spec.task_id)
+            self.c._fail_task(rec, e)
+            return
+        if not node.alive:
+            return  # _on_node_dead already requeued/failed rec
+        protocol.awrite_msg(node.writer, "fwd_task", spec=spec,
+                            result_oids=rec.result_oids, deps=deps,
+                            options=options)
+
+    async def _collect_deps(self, spec: TaskSpec, node: NodeConn):
+        """Bytes for every ref the task needs, except those already on the
+        target node. Objects on a THIRD node route through the head (2-hop;
+        the reference does node↔node direct — acceptable at this fan-in)."""
+        deps = []
+        oids = [v for kind, v in
+                list(spec.args) + list(spec.kwargs.values()) if kind == "ref"]
+        oids += [v for v in spec.nested_refs
+                 if not v.startswith(("actor-", "task-"))]
+        for oid in dict.fromkeys(oids):
+            meta = self.c.objects.get(oid)
+            if meta is None:
+                continue  # node resolves via fetch_object at run time
+            loc = meta.location
+            if loc == f"remote:{node.node_id}":
+                continue  # already local to the target
+            if loc.startswith("remote:"):
+                await self.c._pull_remote(oid)  # stage through the head
+                meta = self.c.objects.get(oid)
+                if meta is None:
+                    continue
+            if meta.location == "inline":
+                deps.append({"oid": oid, "enc": "inline",
+                             "data": meta.inline_value, "size": meta.size,
+                             "contained": list(meta.contained)})
+            elif meta.location in ("shm", "spilled"):
+                self.c._ensure_local(oid)
+                blob = self.c.store.read_raw(oid)
+                deps.append({"oid": oid, "enc": "blob", "data": blob,
+                             "size": meta.size, "meta_len": meta.meta_len,
+                             "contained": list(meta.contained)})
+        return deps
+
+    def _release_mirror(self, node: NodeConn, spec: TaskSpec):
+        if spec.actor_id and not spec.is_actor_creation:
+            return  # methods carry no mirror claim
+        for k, v in spec.resources.items():
+            node.available[k] = node.available.get(k, 0) + v
+
+    # -------------------------------------------------------------- results
+    def _on_task_result(self, node: NodeConn, p: dict):
+        c = self.c
+        rec = node.inflight.pop(p["task_id"], None)
+        if rec is None:
+            return
+        spec: TaskSpec = rec.spec
+        self._release_mirror(node, spec)
+        err = p.get("error")
+        actor = c.actors.get(spec.actor_id) if spec.actor_id else None
+        if actor is not None and not spec.is_actor_creation:
+            actor.in_flight.discard(spec.task_id)
+        if err is not None:
+            retryable = (not spec.actor_id and rec.retries_left > 0
+                         and not rec.cancelled
+                         and (spec.retry_exceptions
+                              or isinstance(err, exc.WorkerCrashedError)))
+            if retryable:
+                rec.retries_left -= 1
+                rec.node_id = None  # re-placed from scratch
+                c._enqueue_ready(rec)
+                c._schedule()
+                return
+            c._fail_task(rec, err)
+            if spec.is_actor_creation and actor is not None:
+                node.actors.discard(spec.actor_id)
+                c._fail_actor(actor, f"creation failed on {node.node_id}: "
+                              f"{err}", allow_restart=False)
+            c._unpin(rec)
+            c._schedule()
+            return
+        for r in p["results"]:
+            c._ingest_result(r, node.node_id)
+        rec.state = "DONE"
+        rec.done.set()
+        c._mark_task_terminal(rec)
+        if spec.is_actor_creation and actor is not None:
+            from .controller import A_DEAD, A_ALIVE
+            if actor.state != A_DEAD:
+                actor.state = A_ALIVE
+        c._unpin(rec)
+        c._schedule()
+
+    # ------------------------------------------------------- object movement
+    async def pull_object(self, oid: str, node_id: str) -> bool:
+        """Fetch an object's bytes from the node that has it into the head
+        store. True on success."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            return False
+        try:
+            # the node waits out still-computing objects (locate_object may
+            # have found the oid "pending"); give its wait headroom
+            p = await asyncio.wait_for(
+                self._rpc(node, "pull_object", oid=oid, timeout=90),
+                timeout=105)
+        except (asyncio.TimeoutError, OSError):
+            return False
+        if not p.get("found"):
+            return False
+        self.c._ingest_bytes(oid, p)
+        # ownership moved to the head: release the node's creation ref (node
+        # -side borrowers hold their own increfs, so this only drops the
+        # producing store's copy once nothing there needs it)
+        self.free_object(oid, node_id)
+        return True
+
+    async def search_object(self, oid: str) -> bool:
+        """Cluster-wide lookup for an oid the head has never seen (e.g. a
+        ref allocated by a node-local sub-task, later serialized into a
+        result the driver deserialized). Ref: object directory
+        (src/ray/object_manager/object_directory.h)."""
+        for node in list(self.nodes.values()):
+            if not node.alive:
+                continue
+            try:
+                p = await asyncio.wait_for(
+                    self._rpc(node, "locate_object", oid=oid), timeout=30)
+            except (asyncio.TimeoutError, OSError):
+                continue
+            if p.get("status") in ("ready", "pending"):
+                self.c._register_remote(oid, node.node_id,
+                                        size=p.get("size", 0),
+                                        meta_len=p.get("meta_len", 0))
+                return True
+        return False
+
+    async def _serve_fetch(self, node: NodeConn, p: dict):
+        """A node asks the head for an object (uplink miss path)."""
+        oid = p["oid"]
+        try:
+            descs = await self.c.get_descriptors([oid], p.get("timeout", 120))
+            kind, payload = descs[0]
+            if kind == "err":
+                self._node_reply(node, p["req_id"], found=False, error=payload)
+            elif kind == "inline":
+                meta = self.c.objects[oid]
+                self._node_reply(node, p["req_id"], found=True, enc="inline",
+                                 data=payload, size=meta.size,
+                                 contained=list(meta.contained))
+            else:  # shm at head (a remote location was pulled in by
+                   # get_descriptors before the descriptor was returned)
+                meta = self.c.objects[oid]
+                blob = self.c.store.read_raw(oid)
+                self._node_reply(node, p["req_id"], found=True, enc="blob",
+                                 data=blob, size=meta.size,
+                                 meta_len=meta.meta_len,
+                                 contained=list(meta.contained))
+        except Exception as e:  # noqa: BLE001 - ship the failure
+            self._node_reply(node, p["req_id"], found=False, error=e)
+
+    async def _serve_up_submit(self, node: NodeConn, p: dict):
+        """A node worker submitted work its node can't place; the head
+        registers shipped deps and places it cluster-wide (spillback)."""
+        try:
+            for d in p.get("deps") or []:
+                self.c._ingest_bytes(d["oid"], d)
+            oids = await self.c.submit(p["spec"])
+            self._node_reply(node, p["req_id"], refs=oids)
+        except Exception as e:  # noqa: BLE001
+            self._node_reply(node, p["req_id"], error=e)
+
+    def free_object(self, oid: str, node_id: str):
+        node = self.nodes.get(node_id)
+        if node is not None and node.alive:
+            protocol.awrite_msg(node.writer, "free_object", oid=oid)
+
+    def cancel(self, task_id: str, node_id: str, force: bool):
+        node = self.nodes.get(node_id)
+        if node is not None and node.alive:
+            protocol.awrite_msg(node.writer, "cancel", task_id=task_id,
+                                force=force)
+
+    def kill_actor(self, actor_id: str, node_id: str, no_restart: bool):
+        node = self.nodes.get(node_id)
+        if node is not None and node.alive:
+            node.actors.discard(actor_id)
+            protocol.awrite_msg(node.writer, "kill_actor", actor_id=actor_id,
+                                no_restart=no_restart)
+
+    # ------------------------------------------------------------ node death
+    def _on_node_dead(self, node: NodeConn):
+        c = self.c
+        print(f"[cluster] node {node.node_id} ({node.host}) disconnected; "
+              f"failing over {len(node.inflight)} tasks, "
+              f"{len(node.actors)} actors", file=sys.stderr)
+        for tid, rec in list(node.inflight.items()):
+            spec = rec.spec
+            self._release_mirror(node, spec)
+            if spec.actor_id and not spec.is_actor_creation:
+                # clear the method from its actor's in-flight set or a
+                # restarted actor would never dispatch again (concurrency
+                # gate counts stale entries)
+                actor = c.actors.get(spec.actor_id)
+                if actor is not None:
+                    actor.in_flight.discard(tid)
+            if (not spec.actor_id and rec.retries_left > 0
+                    and not rec.cancelled):
+                rec.retries_left -= 1
+                rec.node_id = None  # re-placed from scratch
+                c._enqueue_ready(rec)
+            else:
+                c._fail_task(rec, exc.WorkerCrashedError(
+                    f"node {node.node_id} died while running {tid}"))
+        node.inflight.clear()
+        for aid in list(node.actors):
+            actor = c.actors.get(aid)
+            if actor is not None:
+                actor.node_id = None
+                # re-place through the scheduler (may land anywhere) rather
+                # than _fail_actor's local respawn, which assumes a head
+                # worker and head-held resources
+                if not c._requeue_actor_creation(actor):
+                    c._fail_actor(actor, f"node {node.node_id} died",
+                                  allow_restart=False)
+        node.actors.clear()
+        # objects whose only copy lived there are lost; lineage reconstructs
+        # on next access (meta stays, pull fails, _recover_object re-runs)
+        c._schedule()
+
+    # --------------------------------------------------------------- surface
+    def node_rows(self) -> List[dict]:
+        return [{"node_id": n.node_id, "alive": n.alive, "host": n.host,
+                 "resources": dict(n.resources),
+                 "available": dict(n.available),
+                 "inflight": len(n.inflight), "actors": len(n.actors)}
+                for n in self.nodes.values()]
+
+    def totals(self) -> Dict[str, float]:
+        out = dict(self.c.total)
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.resources.items():
+                    out[k] = out.get(k, 0) + v
+        return out
+
+    def availables(self) -> Dict[str, float]:
+        out = dict(self.c.available)
+        for n in self.nodes.values():
+            if n.alive:
+                for k, v in n.available.items():
+                    out[k] = out.get(k, 0) + v
+        return out

@@ -63,6 +63,7 @@ class TaskRecord:
     cancelled: bool = False
     pinned_actors: List[str] = field(default_factory=list)
     pinned_streams: List[str] = field(default_factory=list)
+    node_id: Optional[str] = None  # set when forwarded to a cluster node
 
 
 class _ReadyIndex:
@@ -293,6 +294,7 @@ class ActorRecord:
     death_reason: str = ""
     env: dict = field(default_factory=dict)
     resources_claimed: bool = False  # standing allocation held (exactly-once release)
+    node_id: Optional[str] = None  # cluster node hosting this actor (None = head)
     # distributed handle refcount (ref: Ray's actor handle reference counting,
     # src/ray/core_worker/reference_count.cc — an actor with no reachable
     # handles is terminated). Starts at 1 for the creating handle; serialized
@@ -319,7 +321,7 @@ class PlacementGroupRecord:
 class Controller:
     def __init__(self, socket_path: str, resources: Dict[str, float], job_id: str,
                  max_workers: int = None, store_capacity: int = DEFAULT_CAPACITY,
-                 session_dir: str = None):
+                 session_dir: str = None, cluster_port: int = None):
         self.socket_path = socket_path
         # GCS fault tolerance (named sessions): journal detached actors and
         # spilled objects so the next controller on this session restores
@@ -377,15 +379,28 @@ class Controller:
         self.runtime_envs = RuntimeEnvManager()
         # autoscaler hook: last explicit resource request (sdk.request_resources)
         self.resource_requests: Dict = {}
+        # node-provider provisioning (autoscaler/node_provider.py)
+        self.node_provider = None
+        self.provider_max_nodes = 0
+        self._provider_nodes: Dict[str, float] = {}  # handle -> promised CPU
         # env keys with an async build in flight (built off-loop: a pip venv
         # install can take minutes and must not freeze the controller)
         self._env_building: Set[str] = set()
+        # cross-host control plane (ref: raylet federation through the GCS,
+        # src/ray/gcs/gcs_server/gcs_node_manager.cc). None = single host.
+        self._cluster_port = cluster_port
+        self.cluster = None
+        self._pulls: Dict[str, asyncio.Task] = {}  # in-flight remote pulls
 
     # ------------------------------------------------------------------ setup
     async def start(self):
         self.loop = asyncio.get_running_loop()
         self._server = await asyncio.start_unix_server(self._on_conn, path=self.socket_path)
         self.loop.create_task(self._reaper())
+        if self._cluster_port is not None:
+            from .cluster import ClusterServer
+            self.cluster = ClusterServer(self)
+            await self.cluster.start(self._cluster_port)
         if self.gcs is not None:
             await self._restore_from_journal()
 
@@ -424,6 +439,8 @@ class Controller:
 
     async def shutdown(self):
         self._shutdown = True
+        if self.cluster is not None:
+            self.cluster.close()
         for w in list(self.workers.values()) + list(self.spawning.values()):
             self._kill_worker_proc(w)
         if self._server:
@@ -604,7 +621,8 @@ class Controller:
             self.cancel(p["task_id"], force=p.get("force", False))
             self._reply(w, p["req_id"], ok=True)
         elif kind == "resources":
-            self._reply(w, p["req_id"], total=dict(self.total), available=dict(self.available))
+            self._reply(w, p["req_id"], total=self.res_total(),
+                        available=self.res_available())
         elif kind == "request_resources":
             self._reply(w, p["req_id"],
                         **self.request_resources(p.get("num_cpus"), p.get("bundles")))
@@ -641,13 +659,17 @@ class Controller:
             self._reply(w, p["req_id"], error=e)
 
     # ------------------------------------------------------------- submission
-    async def submit(self, spec: TaskSpec) -> List[str]:
-        """Register a task; returns result object ids immediately (futures)."""
+    async def submit(self, spec: TaskSpec,
+                     result_oids: List[str] = None) -> List[str]:
+        """Register a task; returns result object ids immediately (futures).
+        `result_oids` preallocates the ids — used when a cluster head
+        forwards a task here, so both controllers name the same objects."""
         if spec.num_returns == "streaming":
-            result_oids = [ids.object_id()]  # the generator handle id
+            result_oids = result_oids or [ids.object_id()]  # generator handle
             self.streams[spec.task_id] = StreamState()
         else:
-            result_oids = [ids.object_id() for _ in range(max(spec.num_returns, 1))]
+            result_oids = result_oids or [
+                ids.object_id() for _ in range(max(spec.num_returns, 1))]
         for oid in result_oids:
             self.objects[oid] = ObjectMeta(object_id=oid, creating_task=spec.task_id)
             self.object_events[oid] = asyncio.Event()
@@ -724,6 +746,9 @@ class Controller:
             return
         for k, v in need.items():
             if v > self.total.get(k, 0):
+                if (self.cluster is not None
+                        and self.cluster.feasible_somewhere(need)):
+                    return  # a cluster node can host it; placement forwards
                 self._fail_task(rec, ValueError(
                     f"Resource request {k}={v} exceeds cluster total {self.total.get(k, 0)} "
                     f"(infeasible; reference behavior: hang + warning — we fail fast)"))
@@ -745,13 +770,49 @@ class Controller:
             if actor.state == A_DEAD:
                 self._fail_task(rec, exc.ActorDiedError(actor.actor_id, actor.death_reason))
                 return
+            if actor.node_id is not None and self.cluster is not None:
+                # the actor lives on a cluster node: methods follow it
+                node = self.cluster.nodes.get(actor.node_id)
+                if node is None or not node.alive:
+                    self._fail_task(rec, exc.ActorDiedError(
+                        actor.actor_id, f"node {actor.node_id} died"))
+                    return
+                if rec.spec.num_returns == "streaming":
+                    self._fail_task(rec, ValueError(
+                        "streaming generator methods on remote-node actors "
+                        "are not supported yet; place the actor on the head "
+                        "node (NodeAffinity) to stream"))
+                    return
+                actor.in_flight.add(rec.spec.task_id)
+                self.cluster.forward_method(rec, node)
+                return
             actor.queue.append(rec)
         else:
+            if (self.cluster is not None and self.cluster.nodes
+                    and not rec.spec.placement_group_id
+                    and rec.spec.num_returns != "streaming"):
+                node = self.cluster.place(rec)
+                if rec.state == FAILED:
+                    return  # hard NodeAffinity to a dead node
+                if node is not None:
+                    options = None
+                    if rec.spec.is_actor_creation:
+                        a = self.actors.get(rec.spec.actor_id)
+                        options = a.options if a is not None else None
+                    self.cluster.forward_task(rec, node, options)
+                    return
             self.ready_queue.append(rec)
 
     # -------------------------------------------------------------- scheduling
     def _resources_fit(self, need: Dict[str, float], pool: Dict[str, float]) -> bool:
         return all(pool.get(k, 0) + 1e-9 >= v for k, v in need.items())
+
+    def res_total(self) -> Dict[str, float]:
+        """Cluster-wide totals (just this host when not clustered)."""
+        return self.cluster.totals() if self.cluster else dict(self.total)
+
+    def res_available(self) -> Dict[str, float]:
+        return self.cluster.availables() if self.cluster else dict(self.available)
 
     def _claim(self, need: Dict[str, float], pool: Optional[Dict[str, float]]):
         # pool None = the task's placement group was removed while it ran.
@@ -1003,22 +1064,70 @@ class Controller:
         for _ in range(max(0, want - n_alive)):
             self._spawn_worker()
             spawned += 1
+        # beyond this host: ask the node provider for worker NODES (ref: the
+        # reference autoscaler's StandardAutoscaler adding nodes through its
+        # NodeProvider). Launched-but-unregistered capacity counts, so a
+        # repeated request doesn't double-launch; dead handles are pruned so
+        # a crashed node doesn't count as capacity forever.
+        launched_nodes = []
+        clamped = target > want
+        if (self.cluster is not None and self.node_provider is not None
+                and target > 0):
+            live = set(self.node_provider.non_terminated_nodes())
+            self._provider_nodes = {
+                h: c for h, c in self._provider_nodes.items() if h in live}
+            # registered nodes (provider-launched or manually joined) are in
+            # res_total; add only the promise of live handles whose agent
+            # has not registered yet (matched by pid when the provider can)
+            pid_of = getattr(self.node_provider, "pid_of", lambda _h: None)
+            reg_pids = {n.pid for n in self.cluster.nodes.values()}
+            promised = sum(c for h, c in self._provider_nodes.items()
+                           if pid_of(h) not in reg_pids)
+            per_node = float(getattr(self.node_provider, "cpus_per_node", 2.0))
+            projected = self.res_total().get("CPU", 0.0) + promised
+            while (projected + 1e-9 < target
+                   and len(self._provider_nodes) < self.provider_max_nodes):
+                try:
+                    handle = self.node_provider.create_node(
+                        {"CPU": per_node}, self.cluster.address)
+                except Exception as e:  # noqa: BLE001 - provisioning failure
+                    print(f"[autoscaler] node launch failed: {e!r}",
+                          file=sys.stderr)
+                    break
+                self._provider_nodes[handle] = per_node
+                launched_nodes.append(handle)
+                projected += per_node
+            clamped = projected + 1e-9 < target
         return {"target_cpus": target, "fulfilled_cpus": want,
-                "clamped": target > want, "spawned_workers": spawned}
+                "clamped": clamped,
+                "spawned_workers": spawned, "launched_nodes": launched_nodes}
+
+    def set_node_provider(self, provider, max_nodes: int = 4):
+        """Install the provisioning backend for cluster scale-up (ref:
+        autoscaler NodeProvider). Requires a cluster head (cluster_port)."""
+        if self.cluster is None:
+            raise ValueError("node providers require a cluster head: "
+                             "init(cluster_port=...) first")
+        self.node_provider = provider
+        self.provider_max_nodes = max_nodes
 
     def autoscaler_status(self) -> dict:
         workers = list(self.workers.values()) + list(self.spawning.values())
         pool = [w for w in workers if w.actor_id is None
                 and w.state not in ("dead", "driver")]
-        return {
+        out = {
             "request": dict(self.resource_requests),
             "max_workers": self.max_workers,
             "pool_workers": len(pool),
             "idle_workers": sum(1 for w in pool if w.state == "idle"),
             "pending_tasks": len(self.ready_queue),
-            "total": dict(self.total),
-            "available": dict(self.available),
+            "total": self.res_total(),
+            "available": self.res_available(),
         }
+        if self.cluster is not None:
+            out["nodes"] = len(self.cluster.nodes) + 1
+            out["provider_nodes"] = list(self._provider_nodes)
+        return out
 
     # env vars that bind a process to the accelerator runtime; stripped for
     # CPU-only workers (see WorkerConn.tpu_capable). Single source of truth:
@@ -1299,6 +1408,81 @@ class Controller:
         self.object_events[oid].set()
         self._resolve_dep(oid)
 
+    # ------------------------------------------------- cluster object table
+    def _register_remote(self, oid: str, node_id: str, size: int = 0,
+                         meta_len: int = 0, contained=None):
+        """Record that `oid`'s bytes live in a cluster node's store (ref:
+        object directory locations, src/ray/object_manager)."""
+        meta = self.objects.get(oid)
+        if meta is None:
+            meta = ObjectMeta(object_id=oid)
+            self.objects[oid] = meta
+            self.object_events[oid] = asyncio.Event()
+        if contained and not meta.contained:
+            meta.contained = list(contained)
+            self.incref(meta.contained)
+        meta.size = size
+        meta.meta_len = meta_len
+        meta.location = f"remote:{node_id}"
+        self.object_events[oid].set()
+        self._resolve_dep(oid)
+
+    def _ingest_bytes(self, oid: str, p: dict):
+        """Materialize shipped object bytes into the local table/store.
+        `p`: {"kind": "inline"|"blob", "data", "size", ["meta_len"],
+        ["contained"]} — the wire format for deps, pulls, and fetches."""
+        meta = self.objects.get(oid)
+        if meta is None:
+            meta = ObjectMeta(object_id=oid)
+            self.objects[oid] = meta
+            self.object_events[oid] = asyncio.Event()
+        if p.get("contained") and not meta.contained:
+            meta.contained = list(p["contained"])
+            self.incref(meta.contained)
+        if p["enc"] == "inline":
+            meta.location = "inline"
+            meta.inline_value = p["data"]
+            meta.size = p["size"]
+        else:
+            if not self.store.exists(oid):
+                self.store.put_raw(oid, p["data"])
+                self.store_used += p["size"]
+            meta.meta_len = p["meta_len"]
+            meta.size = p["size"]
+            meta.location = "shm"
+            meta.spill_path = None
+            self._maybe_spill()
+        self.object_events[oid].set()
+        self._resolve_dep(oid)
+
+    def _ingest_result(self, r: dict, node_id: str):
+        """A forwarded task's per-oid result: inline values arrive by value,
+        large values stay in the producing node's store (lazy pull)."""
+        if r["enc"] == "inline":
+            self.register_put(r["oid"], 0, r["size"], r["data"],
+                              r.get("contained"))
+        else:
+            self._register_remote(r["oid"], node_id, r["size"],
+                                  r["meta_len"], r.get("contained"))
+
+    async def _pull_remote(self, oid: str) -> bool:
+        """Pull a remote-located object's bytes into the head store,
+        deduplicating concurrent pulls of the same oid."""
+        if self.cluster is None:
+            return False
+        task = self._pulls.get(oid)
+        if task is None:
+            meta = self.objects.get(oid)
+            if meta is None:
+                return False
+            if not meta.location.startswith("remote:"):
+                return True  # raced: someone else already pulled it
+            node_id = meta.location.split(":", 1)[1]
+            task = self.loop.create_task(self.cluster.pull_object(oid, node_id))
+            self._pulls[oid] = task
+            task.add_done_callback(lambda _f: self._pulls.pop(oid, None))
+        return await task
+
     def _resolve_dep(self, oid: str):
         for tid in self.dep_waiters.pop(oid, ()):
             rec = self.tasks.get(tid)
@@ -1370,11 +1554,18 @@ class Controller:
         if meta.location == "inline":
             return ("inline", meta.inline_value)
         lost = False
-        try:
-            self._ensure_local(oid)  # restores spilled data
-            lost = meta.location == "shm" and not self.store.exists(oid)
-        except (FileNotFoundError, OSError):
-            lost = True  # spill file vanished
+        if meta.location.startswith("remote:"):
+            # bytes live in a cluster node's store; pull them in (ref:
+            # object_manager.cc Pull). Failure = node gone → lost → lineage.
+            lost = not await self._pull_remote(oid)
+            if not lost and meta.location == "inline":
+                return ("inline", meta.inline_value)
+        if not lost:
+            try:
+                self._ensure_local(oid)  # restores spilled data
+                lost = meta.location == "shm" and not self.store.exists(oid)
+            except (FileNotFoundError, OSError):
+                lost = True  # spill file vanished
         if not lost:
             return ("shm", meta.meta_len)
         if _depth >= 3 or not await self._recover_object(oid):
@@ -1504,6 +1695,9 @@ class Controller:
         if meta.location == "shm":
             self.store.delete_segment(oid)
             self.store_used -= meta.size
+        elif meta.location.startswith("remote:") and self.cluster is not None:
+            # the bytes live on a node; release that node's creation ref
+            self.cluster.free_object(oid, meta.location.split(":", 1)[1])
         elif meta.location == "spilled" and meta.spill_path:
             try:
                 os.remove(meta.spill_path)
@@ -1552,6 +1746,10 @@ class Controller:
         Returns True when a reconstruction is running (or already queued)."""
         rec = self._lineage_rec(oid)
         if rec is None:
+            if self.cluster is not None:
+                # an oid the head never allocated (a node-local sub-task's
+                # result serialized into data): ask the cluster who has it
+                return await self.cluster.search_object(oid)
             return False
         if rec.state in (PENDING, PENDING_DEPS, "SPAWNING", RUNNING):
             return True  # reconstruction already in flight
@@ -1716,6 +1914,15 @@ class Controller:
         actor = self.actors.get(actor_id)
         if actor is None:
             return
+        if actor.node_id is not None and self.cluster is not None:
+            # the hosting node kills its local worker and owns any restart;
+            # permanent death there comes back as an actor_dead report
+            self.cluster.kill_actor(actor_id, actor.node_id, no_restart)
+            if no_restart:
+                actor.restarts_used = (actor.options.max_restarts + 1
+                                       if actor.options else 1)
+                self._fail_actor(actor, reason, allow_restart=False)
+            return
         w = self.workers.get(actor.worker_id)
         if w is not None:
             self._kill_worker_proc(w)
@@ -1725,6 +1932,31 @@ class Controller:
         if no_restart:
             actor.restarts_used = actor.options.max_restarts + 1 if actor.options else 1
         self._fail_actor(actor, reason, allow_restart=not no_restart)
+
+    def _requeue_actor_creation(self, actor: ActorRecord) -> bool:
+        """Re-place a restartable actor whose cluster node died: a fresh
+        creation TaskRecord through the normal placement path (may land on
+        the head or any other node). Returns False when out of restarts."""
+        if not (actor.options is not None
+                and (actor.options.max_restarts == -1
+                     or actor.restarts_used < actor.options.max_restarts)):
+            return False
+        actor.restarts_used += 1
+        actor.state = A_RESTARTING
+        actor.worker_id = None
+        actor.node_id = None
+        actor.resources_claimed = False
+        cspec = actor.creation_spec
+        old_rec = self.tasks[cspec.task_id]
+        rec = TaskRecord(spec=cspec, result_oids=old_rec.result_oids,
+                         ts_submit=time.time())
+        rec.pinned, old_rec.pinned = old_rec.pinned, []
+        rec.pinned_actors, old_rec.pinned_actors = old_rec.pinned_actors, []
+        rec.pinned_streams, old_rec.pinned_streams = old_rec.pinned_streams, []
+        self.tasks[cspec.task_id] = rec
+        self._enqueue_ready(rec)
+        self._schedule()
+        return True
 
     def _fail_actor(self, actor: ActorRecord, reason: str, allow_restart: bool):
         if actor.state == A_DEAD:
@@ -1852,6 +2084,14 @@ class Controller:
         if rec is None:
             return
         rec.cancelled = True
+        if (rec.state == RUNNING and rec.node_id is not None
+                and self.cluster is not None):
+            node = self.cluster.nodes.get(rec.node_id)
+            if node is not None and node.alive:
+                self.cluster.cancel(task_id, rec.node_id, force)
+                return
+            # stale node_id (node died; task since failed or retried
+            # elsewhere): fall through to the local paths
         if rec.state in (PENDING, PENDING_DEPS):
             # _fail_task also removes the rec from the ready index
             self._fail_task(rec, exc.TaskCancelledError(task_id))
@@ -1984,9 +2224,14 @@ class Controller:
                      "actor_id": w.actor_id, "running": len(w.running)}
                     for w in self.workers.values()]
         if kind == "nodes":
-            return [{"node_id": self.node_id, "alive": True, "resources": dict(self.total),
-                     "available": dict(self.available), "object_store_used": self.store_used,
+            rows = [{"node_id": self.node_id, "alive": True, "is_head": True,
+                     "resources": dict(self.total),
+                     "available": dict(self.available),
+                     "object_store_used": self.store_used,
                      "object_store_capacity": self.store_capacity}]
+            if self.cluster is not None:
+                rows.extend(self.cluster.node_rows())
+            return rows
         if kind == "placement_groups":
             return [{"pg_id": pg.pg_id, "name": pg.name, "strategy": pg.strategy,
                      "bundles": [dict(b.resources) for b in pg.bundles]}

@@ -1,0 +1,459 @@
+"""Worker-node agent: a full local controller plus one TCP uplink to the head.
+
+Run with:  python -m ray_tpu._private.node_main --address HEAD_HOST:PORT
+
+Reference parity: a raylet joining a cluster (src/ray/raylet/main.cc →
+NodeManager registration with the GCS). The re-design keeps every
+single-host mechanism intact by running a complete Controller locally (own
+shm arena, own worker pool, own scheduler, runtime envs, streams, restarts)
+and adding exactly two cross-host behaviors:
+
+- DOWNLINK: the head forwards deps-ready tasks/actor-creations here
+  ("fwd_task" with dep bytes); the agent registers the deps into the local
+  store and pushes the spec through the normal local submit path, then
+  reports per-oid results upward — inline values by value, large values by
+  location (bytes stay in this node's store until the head pulls them).
+- UPLINK: local misses spill up. A worker get() of an object this node has
+  never seen asks the head ("fetch_object"); a worker submit the node
+  cannot or should not place (infeasible here, SPREAD/NodeAffinity, method
+  on an actor living elsewhere) is re-submitted at the head ("up_submit") —
+  the analog of raylet spillback scheduling.
+"""
+
+import argparse
+import asyncio
+import os
+import socket as _socket
+import sys
+import time
+from typing import Dict, Optional
+
+from .. import exceptions as exc
+from . import ids, paths, protocol
+from .cluster import HEARTBEAT_S, cluster_token
+from .controller import Controller, DEFAULT_CAPACITY
+from .task_spec import ObjectMeta, TaskSpec
+
+
+class NodeController(Controller):
+    """Local controller with uplink spillback for work and objects."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.agent: Optional["NodeAgent"] = None
+        self._head_actors = set()   # actor_ids created on behalf of the head
+        self._uplink_pulls = set()  # oids with an uplink fetch in flight
+
+    def _fail_actor(self, actor, reason, allow_restart):
+        was_dead = actor.state == "DEAD"
+        super()._fail_actor(actor, reason, allow_restart)
+        if (not was_dead and actor.state == "DEAD"
+                and actor.actor_id in self._head_actors
+                and self.agent is not None and self.agent.writer is not None):
+            # permanent death of a head-placed actor: report up so the head
+            # fails its record (restarts below max_restarts stay node-local)
+            self._head_actors.discard(actor.actor_id)
+            try:
+                protocol.awrite_msg(self.agent.writer, "actor_dead",
+                                    actor_id=actor.actor_id, reason=reason)
+            except OSError:
+                pass
+
+    # -- object miss → ask the head ---------------------------------------
+    async def _recover_object(self, oid: str) -> bool:
+        """Local lineage first; else register a pending entry and pull from
+        the head in the background, so the caller's own get() timeout (not
+        the fetch RPC's) governs how long it waits."""
+        if await super()._recover_object(oid):
+            return True
+        if self.agent is None:
+            return False
+        meta = self.objects.get(oid)
+        if meta is None:
+            meta = ObjectMeta(object_id=oid)
+            self.objects[oid] = meta
+            self.object_events[oid] = asyncio.Event()
+        elif meta.location in ("shm", "spilled"):
+            meta.location = "pending"  # local copy lost: refetch
+            self.object_events[oid].clear()
+        if oid not in self._uplink_pulls:
+            self._uplink_pulls.add(oid)
+            self.loop.create_task(self._pull_uplink(oid))
+        return True
+
+    async def _pull_uplink(self, oid: str):
+        try:
+            ok = await self.agent.fetch_object(oid)
+        except Exception:  # noqa: BLE001 - uplink hiccup = not found
+            ok = False
+        finally:
+            self._uplink_pulls.discard(oid)
+        if not ok:
+            meta = self.objects.get(oid)
+            if meta is not None and meta.location == "pending":
+                meta.error = exc.ObjectLostError(oid)
+                meta.location = "error"
+                ev = self.object_events.get(oid)
+                if ev is not None:
+                    ev.set()
+                # wake queued tasks waiting on this dep; they dispatch and
+                # fail at argument materialization (same contract as
+                # _fail_task's error objects)
+                self._resolve_dep(oid)
+
+    # -- work this node shouldn't place → head ----------------------------
+    def _spills_up(self, spec: TaskSpec) -> bool:
+        if self.agent is None or spec.placement_group_id:
+            return False
+        if spec.actor_id and not spec.is_actor_creation:
+            # method on an actor this node doesn't host
+            return spec.actor_id not in self.actors
+        from ..util.scheduling_strategies import NodeAffinitySchedulingStrategy
+        strat = spec.scheduling_strategy
+        if isinstance(strat, NodeAffinitySchedulingStrategy):
+            return strat.node_id != self.node_id
+        if strat == "SPREAD":
+            return True
+        return any(v > self.total.get(k, 0) + 1e-9
+                   for k, v in spec.resources.items())
+
+    async def submit(self, spec: TaskSpec, result_oids=None):
+        if self._spills_up(spec):
+            return await self.agent.up_submit(spec)
+        oids = await super().submit(spec, result_oids=result_oids)
+        rec = self.tasks.get(spec.task_id)
+        if rec is not None and self.agent is not None:
+            # deps this node has never seen (head- or sibling-produced
+            # objects used as args): start uplink pulls so the queued task
+            # can eventually dispatch
+            for oid in list(rec.deps_remaining):
+                if oid not in self.objects:
+                    await self._recover_object(oid)
+        return oids
+
+    def cancel(self, task_id: str, force: bool = False):
+        if self.agent is not None:
+            tid = task_id
+            if tid.startswith("obj-"):
+                meta = self.objects.get(tid)
+                tid = (meta.creating_task if meta and meta.creating_task
+                       else tid)
+            if tid not in self.tasks:
+                self.loop.create_task(
+                    self._up_fire("up_cancel", task_id=task_id, force=force))
+                return
+        super().cancel(task_id, force)
+
+    def kill_actor(self, actor_id: str, no_restart: bool = True,
+                   reason: str = "killed via kill()"):
+        if self.agent is not None and actor_id not in self.actors:
+            self.loop.create_task(self._up_fire(
+                "up_kill_actor", actor_id=actor_id, no_restart=no_restart))
+            return
+        super().kill_actor(actor_id, no_restart, reason)
+
+    async def _up_fire(self, kind: str, **payload):
+        try:
+            await self.agent._rpc(kind, **payload)
+        except Exception:  # noqa: BLE001 - best-effort control message
+            pass
+
+    async def _handle_worker_msg(self, w, kind, p):
+        if kind == "get_actor" and self.agent is not None:
+            # named lookup misses resolve at the head (names are head-owned)
+            try:
+                aid = self.lookup_actor(p["name"], p.get("namespace"))
+                w.actor_refs[aid] = w.actor_refs.get(aid, 0) + 1
+                self._reply(w, p["req_id"], actor_id=aid)
+            except ValueError:
+                self.loop.create_task(self._uplink_get_actor(w, p))
+            return
+        await super()._handle_worker_msg(w, kind, p)
+
+    async def _uplink_get_actor(self, w, p):
+        try:
+            resp = await self.agent._rpc("up_lookup_actor", name=p["name"],
+                                         namespace=p.get("namespace"))
+            if "error" in resp:
+                raise resp["error"]
+            self._reply(w, p["req_id"], actor_id=resp["actor_id"])
+        except Exception as e:  # noqa: BLE001
+            self._reply(w, p["req_id"], error=e)
+
+
+class NodeAgent:
+    def __init__(self, controller: NodeController, head_addr: str):
+        self.c = controller
+        controller.agent = self
+        self.head_host, port = head_addr.rsplit(":", 1)
+        self.head_port = int(port)
+        self.reader = None
+        self.writer = None
+        self._reqs: Dict[int, asyncio.Future] = {}
+        self._req_counter = 0
+        self._watchers = 0
+
+    # ------------------------------------------------------------ lifecycle
+    async def run(self):
+        self.reader, self.writer = await asyncio.open_connection(
+            self.head_host, self.head_port)
+        # plaintext auth line first; pickle framing only after (see
+        # ClusterServer._on_node)
+        self.writer.write(f"RTPU1 {cluster_token()}\n".encode())
+        protocol.awrite_msg(self.writer, "register_node",
+                            node_id=self.c.node_id,
+                            resources=dict(self.c.total),
+                            host=_socket.gethostname(), pid=os.getpid())
+        msg = await protocol.aread_msg(self.reader)
+        if msg is None or msg[0] != "register_ok":
+            raise ConnectionError("head rejected registration "
+                                  "(bad RAY_TPU_CLUSTER_TOKEN?)")
+        print(f"[node] {self.c.node_id} joined head at "
+              f"{self.head_host}:{self.head_port}", file=sys.stderr)
+        self.c.loop.create_task(self._heartbeat())
+        while True:
+            msg = await protocol.aread_msg(self.reader)
+            if msg is None:
+                print("[node] head connection lost; shutting down",
+                      file=sys.stderr)
+                return
+            await self._handle(msg[0], msg[1])
+
+    async def _heartbeat(self):
+        while not self.c._shutdown:
+            await asyncio.sleep(HEARTBEAT_S)
+            try:
+                protocol.awrite_msg(self.writer, "stats",
+                                    available=dict(self.c.available),
+                                    total=dict(self.c.total))
+            except OSError:
+                return
+
+    # ------------------------------------------------------------- handlers
+    async def _handle(self, kind: str, p: dict):
+        c = self.c
+        if kind == "fwd_task":
+            await self._on_fwd_task(p)
+        elif kind == "resp":
+            fut = self._reqs.pop(p.pop("req_id"), None)
+            if fut is not None and not fut.done():
+                fut.set_result(p)
+        elif kind == "pull_object":
+            # async: a pull may target an object a local task is STILL
+            # COMPUTING (the head learned the oid via locate_object) — wait
+            # for it rather than replying not-found
+            self.c.loop.create_task(self._on_pull_object(p))
+        elif kind == "locate_object":
+            meta = c.objects.get(p["oid"])
+            if meta is None:
+                self._reply(p["req_id"], status="unknown")
+            elif meta.location == "pending":
+                self._reply(p["req_id"], status="pending")
+            else:
+                self._reply(p["req_id"], status="ready", size=meta.size,
+                            meta_len=meta.meta_len)
+        elif kind == "free_object":
+            c.decref([p["oid"]])
+        elif kind == "cancel":
+            c.cancel(p["task_id"], force=p.get("force", False))
+        elif kind == "kill_actor":
+            c.kill_actor(p["actor_id"], no_restart=p.get("no_restart", True))
+
+    def _ingest_deps(self, deps) -> list:
+        """Register shipped dep bytes; returns their oids. A re-shipped oid
+        this node already holds gets +1 refcount so each forwarded task's
+        completion can decref exactly once."""
+        oids = []
+        for d in deps or []:
+            meta = self.c.objects.get(d["oid"])
+            if meta is not None and meta.location not in ("pending", "error"):
+                meta.refcount += 1
+            else:
+                self.c._ingest_bytes(d["oid"], d)
+            oids.append(d["oid"])
+        return oids
+
+    async def _on_fwd_task(self, p: dict):
+        spec: TaskSpec = p["spec"]
+        dep_oids = self._ingest_deps(p.get("deps"))
+        if spec.is_actor_creation and spec.actor_id not in self.c.actors:
+            options = p.get("options")
+            # the head owns naming; register anonymously here so a duplicate
+            # name can't collide with a node-local actor
+            import copy
+            options = copy.copy(options)
+            options.name = None
+            self.c.register_actor(spec, options)
+            self.c._head_actors.add(spec.actor_id)
+        # placement already happened at the head; submit through the node
+        # controller with the HEAD's result oids so both controllers name
+        # the same objects (dispatch can fire synchronously inside submit,
+        # so the ids must be right before it runs)
+        spec.scheduling_strategy = None
+        try:
+            await self.c.submit(spec, result_oids=list(p["result_oids"]))
+        except Exception as e:  # noqa: BLE001
+            protocol.awrite_msg(self.writer, "task_result",
+                                task_id=spec.task_id, error=e, results=[])
+            return
+        rec = self.c.tasks[spec.task_id]
+        self.c.loop.create_task(self._watch(rec, dep_oids))
+
+    async def _watch(self, rec, dep_oids=()):
+        await rec.done.wait()
+        results = []
+        error = None
+        for oid in rec.result_oids:
+            meta = self.c.objects.get(oid)
+            if meta is None:
+                error = RuntimeError(f"result {oid} vanished")
+                break
+            if meta.location == "error":
+                error = meta.error
+                break
+            if meta.location == "inline":
+                results.append({"oid": oid, "enc": "inline",
+                                "data": meta.inline_value, "size": meta.size,
+                                "contained": list(meta.contained)})
+            else:
+                results.append({"oid": oid, "enc": "remote",
+                                "size": meta.size, "meta_len": meta.meta_len,
+                                "contained": list(meta.contained)})
+        if error is not None:
+            protocol.awrite_msg(self.writer, "task_result",
+                                task_id=rec.spec.task_id, error=error,
+                                results=[])
+        else:
+            protocol.awrite_msg(self.writer, "task_result",
+                                task_id=rec.spec.task_id, results=results)
+        if dep_oids:
+            # drop this task's hold on its shipped dep copies (pins taken by
+            # submit are already released; _evict guards on pinned)
+            self.c.decref(list(dep_oids))
+
+    async def _on_pull_object(self, p: dict):
+        c = self.c
+        oid = p["oid"]
+        meta = c.objects.get(oid)
+        if meta is not None and meta.location == "pending":
+            ev = c.object_events.get(oid)
+            if ev is not None:
+                try:
+                    await asyncio.wait_for(ev.wait(), p.get("timeout", 120))
+                except asyncio.TimeoutError:
+                    pass
+            meta = c.objects.get(oid)
+        if meta is None or meta.location in ("pending", "error"):
+            self._reply(p["req_id"], found=False)
+            return
+        if meta.location == "inline":
+            self._reply(p["req_id"], found=True, enc="inline",
+                        data=meta.inline_value, size=meta.size,
+                        contained=list(meta.contained))
+            return
+        try:
+            c._ensure_local(oid)
+            blob = c.store.read_raw(oid)
+        except Exception:  # noqa: BLE001 - segment vanished
+            self._reply(p["req_id"], found=False)
+            return
+        self._reply(p["req_id"], found=True, enc="blob", data=blob,
+                    size=meta.size, meta_len=meta.meta_len,
+                    contained=list(meta.contained))
+
+    # ----------------------------------------------------------- uplink rpc
+    def _reply(self, req_id, **payload):
+        protocol.awrite_msg(self.writer, "resp", req_id=req_id, **payload)
+
+    def _rpc(self, kind: str, **payload) -> asyncio.Future:
+        self._req_counter += 1
+        req_id = self._req_counter
+        fut = self.c.loop.create_future()
+        self._reqs[req_id] = fut
+        protocol.awrite_msg(self.writer, kind, req_id=req_id, **payload)
+        return fut
+
+    async def fetch_object(self, oid: str, timeout: float = 120) -> bool:
+        """Pull an object this node has never seen from the head (which pulls
+        it from its owner node if needed). Registers it locally on success."""
+        try:
+            p = await asyncio.wait_for(
+                self._rpc("fetch_object", oid=oid, timeout=timeout),
+                timeout=timeout + 10)
+        except (asyncio.TimeoutError, OSError):
+            return False
+        if not p.get("found"):
+            return False
+        self.c._ingest_bytes(oid, p)
+        return True
+
+    async def up_submit(self, spec: TaskSpec):
+        """Submit at the head for cluster-wide placement. Ships bytes for
+        any ref args this node holds locally (the head may not have them)."""
+        deps = []
+        oids = [v for kind, v in
+                list(spec.args) + list(spec.kwargs.values()) if kind == "ref"]
+        for oid in dict.fromkeys(oids):
+            meta = self.c.objects.get(oid)
+            if meta is None or meta.location in ("pending", "error"):
+                continue
+            if meta.location == "inline":
+                deps.append({"oid": oid, "enc": "inline",
+                             "data": meta.inline_value, "size": meta.size,
+                             "contained": list(meta.contained)})
+            else:
+                try:
+                    self.c._ensure_local(oid)
+                    blob = self.c.store.read_raw(oid)
+                except Exception:  # noqa: BLE001
+                    continue
+                deps.append({"oid": oid, "enc": "blob", "data": blob,
+                             "size": meta.size, "meta_len": meta.meta_len,
+                             "contained": list(meta.contained)})
+        p = await self._rpc("up_submit", spec=spec, deps=deps)
+        if "error" in p:
+            raise p["error"]
+        # the result objects live at the head (or wherever it places the
+        # task); local get() of these oids goes through fetch_object
+        return p["refs"]
+
+
+async def _amain(args) -> int:
+    # own shm arena + socket: a node must never collide with a head or
+    # another node on the same host (the single-host test topology)
+    os.environ["RAY_TPU_ARENA"] = \
+        f"rtpu-arena-{os.getpid()}-{ids.new_id('a')[-8:]}"
+    store_bytes = int(args.object_store_memory or DEFAULT_CAPACITY)
+    os.environ["RAY_TPU_STORE_BYTES"] = str(store_bytes)
+    sock = os.path.join(paths.user_tmp_root(),
+                        f"rtpu-node-{os.getpid()}.sock")
+    os.environ["RAY_TPU_ADDRESS"] = sock
+    resources = {"CPU": float(args.num_cpus), "memory": 32 << 30}
+    if args.num_tpus:
+        resources["TPU"] = float(args.num_tpus)
+    import json
+    for k, v in (json.loads(args.resources) if args.resources else {}).items():
+        resources[k] = float(v)
+    controller = NodeController(sock, resources, job_id=ids.job_id(),
+                                store_capacity=store_bytes)
+    await controller.start()
+    agent = NodeAgent(controller, args.address)
+    try:
+        await agent.run()
+    finally:
+        await controller.shutdown()
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="ray_tpu worker node (joins a head started with "
+                    "ray_tpu.init(cluster_port=...))")
+    ap.add_argument("--address", required=True, help="head HOST:PORT")
+    ap.add_argument("--num-cpus", type=float, default=float(os.cpu_count() or 4))
+    ap.add_argument("--num-tpus", type=float, default=0.0)
+    ap.add_argument("--resources", default="", help='extra resources, JSON '
+                    '(e.g. \'{"worker_node": 1}\')')
+    ap.add_argument("--object-store-memory", type=int, default=0)
+    args = ap.parse_args(argv)
+    return asyncio.run(_amain(args))

@@ -1,0 +1,9 @@
+"""`python -m ray_tpu._private.node_main --address HEAD:PORT` — join a
+cluster as a worker node (ref: `ray start --address=...`)."""
+
+import sys
+
+from .node_agent import main
+
+if __name__ == "__main__":
+    sys.exit(main())

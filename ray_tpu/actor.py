@@ -193,6 +193,11 @@ class ActorClass:
         eargs, ekwargs, nested = encode_call(args, kwargs)
         creation.args, creation.kwargs = eargs, ekwargs
         creation.nested_refs = nested
+        # placement: NodeAffinity/SPREAD ride the spec; PG strategies set the
+        # bundle fields (same plumbing as remote_function.py)
+        creation.scheduling_strategy = opts.get("scheduling_strategy")
+        from .remote_function import _apply_scheduling_strategy
+        _apply_scheduling_strategy(creation, opts.get("scheduling_strategy"))
         acopts = ActorCreationOptions(
             max_restarts=opts.get("max_restarts", 0),
             max_task_retries=opts.get("max_task_retries", 0),

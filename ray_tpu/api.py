@@ -51,12 +51,18 @@ def is_initialized() -> bool:
 
 def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
          object_store_memory=None, ignore_reinit_error=False, max_workers=None,
-         address=None, session_name=None, **_compat):
+         address=None, session_name=None, cluster_port=None, **_compat):
     """Start the ray_tpu runtime in this process (the driver), or — with
     `address` — ATTACH to a session another process started (reference:
     ray.init(address="auto") / address=<endpoint>). `address` is the
     controller's unix socket path, or "auto" to read RAY_TPU_ADDRESS (set by
     the owning session and inherited by its workers and submitted jobs).
+
+    `cluster_port` makes this driver a cluster HEAD (ref: `ray start
+    --head --port=N`): worker hosts join with
+    `python -m ray_tpu._private.node_main --address <host>:<port>` and their
+    CPUs/TPUs become schedulable (see _private/cluster.py). 0 picks an
+    ephemeral port; read the bound address via `ray_tpu.cluster_address()`.
 
     Unrecognized reference kwargs (dashboard_*, logging_*) are accepted and
     ignored for drop-in compatibility.
@@ -111,7 +117,8 @@ def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
             sock, total, job_id=ids.job_id(),
             max_workers=max_workers,
             store_capacity=capacity,
-            session_dir=session_dir)
+            session_dir=session_dir,
+            cluster_port=cluster_port)
 
         loop = asyncio.new_event_loop()
         started = threading.Event()
@@ -283,6 +290,17 @@ def cluster_resources():
 def nodes():
     _ensure_init()
     return state.global_client().state("nodes")
+
+
+def cluster_address():
+    """The head's TCP endpoint ("host:port") when this driver was started
+    with init(cluster_port=...); None otherwise. Worker hosts join with
+    `python -m ray_tpu._private.node_main --address <this>`."""
+    _ensure_init()
+    ctl = getattr(_runtime, "controller", None)
+    if ctl is None or ctl.cluster is None:
+        return None
+    return ctl.cluster.address
 
 
 def timeline(filename=None):

@@ -1,14 +1,17 @@
 """Autoscaler surface (ref: python/ray/autoscaler/).
 
-Single-host TPU design: the reference autoscaler adds cloud nodes to meet
-resource demand (autoscaler/_private/autoscaler.py:1-1572); here the unit of
-elasticity is the worker-process pool, which the controller already scales
-demand-driven. This package exposes the explicit-demand hooks
-(`sdk.request_resources`) and observability (`sdk.status`) with reference
-semantics: requests overwrite, are clamped to what the host can fulfil, and
-warm workers ahead of the tasks that need them.
+Two units of elasticity, mirroring the reference split:
+
+- worker PROCESSES on each host: the controller scales these demand-driven
+  (and `sdk.request_resources` warms them ahead of bursts);
+- worker NODES across hosts: with a cluster head (init(cluster_port=...))
+  and a provider installed via `sdk.set_node_provider`, requests beyond the
+  cluster's capacity launch node agents through the NodeProvider seam
+  (node_provider.py — the policy/provisioning split of
+  python/ray/autoscaler/node_provider.py).
 """
 
 from ray_tpu.autoscaler import sdk
+from ray_tpu.autoscaler.node_provider import NodeProvider, SubprocessNodeProvider
 
-__all__ = ["sdk"]
+__all__ = ["sdk", "NodeProvider", "SubprocessNodeProvider"]

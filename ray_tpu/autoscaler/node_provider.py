@@ -1,0 +1,117 @@
+"""Node providers: the policy/provisioning seam of the autoscaler.
+
+Reference parity: python/ray/autoscaler/node_provider.py separates the
+autoscaler's POLICY (how many nodes, when) from PROVISIONING (how a node is
+created) — AWS/GCP/K8s implement the same interface. Here the interface is
+re-cut for this runtime's cluster model (head + node agents over TCP,
+_private/cluster.py): a provider "creates a node" by getting a
+`ray_tpu._private.node_main` agent running somewhere with the head's
+address; the node then registers itself, so the provider never talks to the
+scheduler directly.
+
+- `SubprocessNodeProvider` launches agents as local subprocesses. It is the
+  test/fake provider AND genuinely useful on one big host (per-node shm
+  stores and worker pools isolate noisy jobs from each other).
+- A cloud provider (TPU pods via GKE / gcloud) implements the same three
+  methods with its own machinery; see the class docstring sketch.
+"""
+
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class NodeProvider:
+    """Minimal provisioning interface (ref: node_provider.py:1-120).
+
+    Implementations must be non-blocking-ish: `create_node` should kick off
+    provisioning and return a handle; registration with the head happens
+    asynchronously when the agent comes up.
+    """
+
+    def create_node(self, resources: Dict[str, float],
+                    head_address: str) -> str:
+        """Start provisioning one worker node that will join
+        `head_address`. Returns an opaque node handle."""
+        raise NotImplementedError
+
+    def terminate_node(self, handle: str) -> None:
+        raise NotImplementedError
+
+    def non_terminated_nodes(self) -> List[str]:
+        raise NotImplementedError
+
+
+class SubprocessNodeProvider(NodeProvider):
+    """Worker nodes as local `node_main` subprocesses.
+
+    A cloud equivalent (sketch, ref python/ray/autoscaler/_private/gcp):
+    `create_node` = create a TPU-pod/GKE node running
+    `python -m ray_tpu._private.node_main --address <head>` (the head
+    address reachable over the pod network, RAY_TPU_CLUSTER_TOKEN injected
+    as a secret); `terminate_node` = delete the instance; liveness = cloud
+    instance state. The head never changes — nodes always dial in.
+    """
+
+    def __init__(self, cpus_per_node: float = 2.0,
+                 extra_resources: Optional[Dict[str, float]] = None,
+                 env: Optional[Dict[str, str]] = None):
+        self.cpus_per_node = cpus_per_node
+        self.extra_resources = dict(extra_resources or {})
+        self.env = env
+        self._procs: Dict[str, subprocess.Popen] = {}
+        self._n = 0
+
+    def create_node(self, resources: Dict[str, float],
+                    head_address: str) -> str:
+        import json
+        env = dict(self.env if self.env is not None else os.environ)
+        # a node is its own session: never inherit the head's arena/socket
+        env.pop("RAY_TPU_ARENA", None)
+        env.pop("RAY_TPU_ADDRESS", None)
+        extra = {**self.extra_resources,
+                 **{k: v for k, v in resources.items()
+                    if k not in ("CPU", "memory")}}
+        cmd = [sys.executable, "-m", "ray_tpu._private.node_main",
+               "--address", head_address,
+               "--num-cpus", str(resources.get("CPU", self.cpus_per_node))]
+        if extra:
+            cmd += ["--resources", json.dumps(extra)]
+        proc = subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL,
+                                start_new_session=True)
+        self._n += 1
+        handle = f"subproc-node-{self._n}-pid{proc.pid}"
+        self._procs[handle] = proc
+        return handle
+
+    def terminate_node(self, handle: str) -> None:
+        proc = self._procs.pop(handle, None)
+        if proc is not None and proc.poll() is None:
+            import signal
+            try:
+                os.killpg(proc.pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+            deadline = time.time() + 5
+            while time.time() < deadline and proc.poll() is None:
+                time.sleep(0.05)
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+
+    def non_terminated_nodes(self) -> List[str]:
+        return [h for h, p in self._procs.items() if p.poll() is None]
+
+    def pid_of(self, handle: str) -> Optional[int]:
+        """The agent pid for a handle — lets the head match registered
+        nodes (which report their pid) to launch promises."""
+        proc = self._procs.get(handle)
+        return proc.pid if proc is not None else None
+
+    def shutdown(self):
+        for h in list(self._procs):
+            self.terminate_node(h)

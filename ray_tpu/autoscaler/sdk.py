@@ -25,3 +25,16 @@ def status() -> dict:
     """Autoscaler view: last request, pool/idle worker counts, pending task
     demand, and cluster totals (ref: `ray status` / autoscaler reporting)."""
     return state.global_client().autoscaler_status()
+
+
+def set_node_provider(provider, max_nodes: int = 4) -> None:
+    """Install a provisioning backend (autoscaler/node_provider.py) on the
+    cluster head. After this, `request_resources` beyond the cluster's
+    current capacity launches worker nodes through the provider; each node
+    registers itself and becomes schedulable (ref: the reference
+    autoscaler's NodeProvider seam, python/ray/autoscaler/node_provider.py).
+    Driver-side only."""
+    client = state.global_client()
+    if not hasattr(client, "set_node_provider"):
+        raise RuntimeError("set_node_provider must run in the head driver")
+    client.set_node_provider(provider, max_nodes)

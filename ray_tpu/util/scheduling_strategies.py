@@ -1,4 +1,18 @@
-"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py)."""
+"""Scheduling strategies (reference: python/ray/util/scheduling_strategies.py).
+
+With a cluster head (init(cluster_port=...)) these are real multi-node
+policies, resolved once per task when its deps are satisfied
+(_private/cluster.py ClusterServer.place):
+
+- DEFAULT: local-first, overflow to the least-loaded node where the demand
+  fits (queued-but-undispatched local work counts against the head).
+- SPREAD: round-robin over the head + every fitting node.
+- NodeAffinity: that node; `soft` falls back to DEFAULT when it is gone,
+  hard fails fast. Node ids come from `ray_tpu.nodes()`.
+
+On a single host they all collapse to the local scheduler, like the
+reference with a 1-node cluster.
+"""
 
 from dataclasses import dataclass
 from typing import Optional
