@@ -56,6 +56,12 @@ def _load():
         lib.rt_store_lookup.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
                                         ctypes.POINTER(ctypes.c_uint64)]
         lib.rt_store_free.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.rt_store_lookup_pin.restype = ctypes.c_int64
+        lib.rt_store_lookup_pin.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                            ctypes.POINTER(ctypes.c_uint64)]
+        lib.rt_store_unpin.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.rt_store_release_pins.restype = ctypes.c_int
+        lib.rt_store_release_pins.argtypes = [ctypes.c_void_p, ctypes.c_int32]
         lib.rt_store_used.restype = ctypes.c_uint64
         lib.rt_store_used.argtypes = [ctypes.c_void_p]
         lib.rt_store_num_objects.restype = ctypes.c_uint64
@@ -105,6 +111,27 @@ class SlabStore:
 
     def free(self, key: str) -> bool:
         return self._lib.rt_store_free(self._h, key.encode()) == 0
+
+    def lookup_pin(self, key: str):
+        """Atomically look up AND pin: the block's memory stays valid (even
+        across free) until the matching `unpin(offset)`."""
+        size = ctypes.c_uint64()
+        off = self._lib.rt_store_lookup_pin(self._h, key.encode(),
+                                            ctypes.byref(size))
+        if off < 0:
+            return None
+        return off, size.value
+
+    def unpin(self, offset: int) -> None:
+        if self._h:
+            self._lib.rt_store_unpin(self._h, offset)
+
+    def release_pins(self, pid: int) -> int:
+        """Drop every pin held by `pid` (plasma disconnect-cleanup parity);
+        returns how many were released."""
+        if self._h:
+            return self._lib.rt_store_release_pins(self._h, pid)
+        return 0
 
     # -- zero-copy access ----------------------------------------------------
     def view(self, offset: int, size: int) -> memoryview:

@@ -2020,6 +2020,13 @@ class Controller:
         if w.state == "dead":
             return
         w.state = "dead"
+        if w.pid:
+            # reclaim the dead client's arena pins (plasma disconnect
+            # cleanup) so its zero-copy reads can't zombie blocks forever
+            try:
+                self.store.release_pins_of(w.pid)
+            except Exception:  # noqa: BLE001 - arena already closed
+                pass
         # Undo outstanding blocked-CPU releases first: the failure paths below
         # release each task's full resources, which would double-release the
         # CPU that _on_blocked already handed back.

@@ -15,6 +15,7 @@ high-churn small objects.
 """
 
 import os
+import weakref
 from multiprocessing import shared_memory, resource_tracker
 
 from . import serialization
@@ -146,18 +147,44 @@ class StoreClient:
 
     # -- read path ----------------------------------------------------------
     def get(self, object_id: str, meta_len: int):
-        """Attach and deserialize; buffers alias store memory (zero-copy)."""
-        cached = self._attached.get(object_id)
-        if cached is not None:
-            return cached.value
+        """Attach and deserialize; buffers alias store memory (zero-copy).
+
+        Slab arena: the lookup takes a PIN in the arena (plasma semantics —
+        eviction zombies a pinned block instead of recycling its bytes). The
+        unpin finalizer rides the BUFFER HOLDER (the ctypes view the
+        memoryview exports), not the deserialized value: every zero-copy
+        derivative — numpy views via .base, arrow buffers via their foreign
+        base object, slices of either — keeps the holder alive through its
+        buffer chain, so the pin lasts exactly as long as ANY alias of the
+        bytes exists. The per-pid ledger (rt_store_release_pins) reclaims
+        pins of crashed clients."""
+        entry = self._attached.get(object_id)
+        if entry is not None:
+            value = entry() if isinstance(entry, weakref.ref) else entry.value
+            if value is not None:
+                return value
+            self._attached.pop(object_id, None)  # value died; pin follows
         if self._slab is not None:
-            loc = self._slab.lookup(object_id)
+            loc = self._slab.lookup_pin(object_id)
             if loc is None:
                 raise FileNotFoundError(f"object {object_id} not in arena")
             off, size = loc
             mv = self._slab.view(off, size)
+            holder = mv.obj  # the ctypes array backing every sub-view
+            slab = self._slab
+
+            def _unpin(offset=off):
+                try:
+                    slab.unpin(offset)
+                except Exception:  # noqa: BLE001 - interpreter teardown
+                    pass
+
+            weakref.finalize(holder, _unpin)
             value = serialization.loads_oob(mv[:meta_len], mv[meta_len:])
-            self._attached[object_id] = LocalObject(None, value, size)
+            try:
+                self._attached[object_id] = weakref.ref(value)
+            except TypeError:
+                pass  # not weakref-able: skip the dedup cache (still safe)
             return value
         shm = shared_memory.SharedMemory(name=seg_name(object_id))
         _unregister(shm)
@@ -180,6 +207,8 @@ class StoreClient:
 
     def release(self, object_id: str):
         loc = self._attached.pop(object_id, None)
+        if isinstance(loc, weakref.ref):
+            return  # slab entry: the value's finalizer owns the unpin
         if loc is not None and loc.shm is not None:
             loc.value = None
             try:
@@ -202,10 +231,11 @@ class StoreClient:
             return False
 
     def delete_segment(self, object_id: str):
-        """Free the object's storage (controller-side eviction)."""
-        self.release(object_id)
+        """Free the object's storage (controller-side eviction). Never drops
+        this process's own attachment: live zero-copy values keep their pin
+        (slab) or their open mapping (pershm) until they die."""
         if self._slab is not None:
-            self._slab.free(object_id)
+            self._slab.free(object_id)  # zombies the block if pinned
             return
         try:
             shm = shared_memory.SharedMemory(name=seg_name(object_id))
@@ -231,9 +261,21 @@ class StoreClient:
         os.remove(path)
         return self.put_raw(object_id, blob)
 
+    def release_pins_of(self, pid: int) -> int:
+        """Reclaim every arena pin held by a (dead) client process — the
+        plasma disconnect-cleanup analog. Controller calls this when a
+        worker dies so its pinned blocks can be evicted."""
+        if self._slab is not None:
+            return self._slab.release_pins(pid)
+        return 0
+
     def close(self, unlink_arena: bool = False):
         for oid in list(self._attached):
             self.release(oid)
         if self._slab is not None:
+            # drop any pins still registered to this process: after close
+            # the finalizers can't reach the arena, and exit would otherwise
+            # leave zombie blocks pinned forever
+            self._slab.release_pins(os.getpid())
             self._slab.close(unlink=unlink_arena)
             self._slab = None

@@ -41,13 +41,24 @@ MAX_TASKS_PER_OP = 8
 class ShuffleOp:
     """Streaming all-to-all: per-block partition map + per-partition reduce.
 
-    map_fn(block, num_partitions, block_index) -> tuple of num_partitions
-    blocks; reduce_fn(parts, partition_index) -> one output block.
+    map_fn(block, num_partitions, block_index[, ctx]) -> tuple of
+    num_partitions blocks; reduce_fn(parts, partition_index) -> one output
+    block.
+
+    `sample_fn`/`plan_fn` add a SAMPLING phase (ref: sampled range
+    partitioning, python/ray/data/_internal/planner/exchange/
+    sort_task_spec.py): tiny per-block samples (sample_fn, run as tasks as
+    blocks arrive) feed plan_fn once the input is complete, and its result
+    — e.g. range boundaries — is passed to every map task as `ctx`. Input
+    block BYTES wait in the (spillable) object store meanwhile; the driver
+    holds refs only, so no process ever concatenates the dataset.
     """
     name: str
-    map_fn: Callable[[pa.Table, int, int], tuple]
+    map_fn: Callable[..., tuple]
     reduce_fn: Callable[[List[pa.Table], int], pa.Table]
     num_partitions: int = 16
+    sample_fn: Callable[[pa.Table], object] = None
+    plan_fn: Callable[[List[object]], object] = None
 
 
 class _OpState:
@@ -121,9 +132,16 @@ class _ShuffleState(_OpState):
         self.reduce_started = False
         self.pending_reduce = collections.deque()  # partition idxs not launched
         self.reduce_inflight = {}             # ref -> partition idx
+        # sampling phase (ops with sample_fn): block idx -> tiny sample
+        self.samples = {}
+        self.sample_inflight = {}             # ref -> block idx
+        self.sampled = set()                  # block idxs with a sample task
+        self.ctx = None
+        self.planned = op.sample_fn is None   # no sampling → maps run eagerly
 
     def pending_refs(self):
-        return list(self.map_inflight) + list(self.reduce_inflight)
+        return (list(self.map_inflight) + list(self.reduce_inflight)
+                + list(self.sample_inflight))
 
     def done(self):
         return (self.reduce_started and not self.pending_reduce
@@ -136,8 +154,8 @@ def _reduce_task(refs, p, _fn):
     return _fn(parts, p)
 
 
-def _single_part_map(ref, _map_fn, idx):
-    return _map_fn(ref, 1, idx)[0]
+def _single_part_map(ref, _map_fn, idx, *extra):
+    return _map_fn(ref, 1, idx, *extra)[0]
 
 
 class StreamingExecutor:
@@ -157,8 +175,12 @@ class StreamingExecutor:
                 name, fn = stage
                 self.chain.append(_MapState(name, fn, op_budget))
         self._remote_cache = {}
-        # peak bytes sitting in queues (tests assert backpressure bounds this)
+        # peak bytes sitting in driver-gated queues (tests assert
+        # backpressure bounds this)
         self.peak_accounted_bytes = 0
+        # peak bytes parked in the store behind sampling barriers (spill-
+        # managed; tracked for introspection, not gated)
+        self.peak_barrier_store_bytes = 0
 
     # ------------------------------------------------------------- remotes
     def _remote(self, key, fn, num_returns=1):
@@ -175,18 +197,44 @@ class StreamingExecutor:
         except Exception:  # noqa: BLE001 - size is advisory
             return [1 << 20] * len(refs)
 
+    @staticmethod
+    def _is_barrier(st) -> bool:
+        """A sampling shuffle's input is a BARRIER BUFFER: refs whose bytes
+        wait in the (spillable) object store until boundaries are planned.
+        They are store memory, not driver-queue memory — upstream is not
+        paused for them and they don't count against the op budget (ref:
+        ray.data's AllToAllOperator materializes refs in the store)."""
+        return isinstance(st, _ShuffleState) and st.op.sample_fn is not None
+
     def _account(self):
-        total = sum(s.inq_bytes + s.out_bytes for s in self.chain)
+        total = 0
+        barrier = 0
+        for i, s in enumerate(self.chain):
+            if self._is_barrier(s):
+                barrier += s.inq_bytes
+            else:
+                total += s.inq_bytes
+            if i + 1 < len(self.chain) and self._is_barrier(self.chain[i + 1]):
+                # this op's output queue drains straight into a barrier
+                # buffer next loop: those refs are store-parked, not gated
+                barrier += s.out_bytes
+            else:
+                total += s.out_bytes
         if total > self.peak_accounted_bytes:
             self.peak_accounted_bytes = total
+        if barrier > self.peak_barrier_store_bytes:
+            self.peak_barrier_store_bytes = barrier
 
     def _pressure(self, i, n_inflight):
         """Projected unconsumed bytes downstream of chain[i]: what's queued
         (next op's input queue, or the sink's own output queue) plus the
         expected bytes of results already in flight."""
         st = self.chain[i]
-        queued = (self.chain[i + 1].inq_bytes if i + 1 < len(self.chain)
-                  else st.out_bytes)
+        if i + 1 < len(self.chain):
+            nxt = self.chain[i + 1]
+            queued = 0 if self._is_barrier(nxt) else nxt.inq_bytes
+        else:
+            queued = st.out_bytes
         return queued + n_inflight * st.avg_out
 
     # ------------------------------------------------------------- dispatch
@@ -214,23 +262,45 @@ class StreamingExecutor:
                     st.inflight[out] = idx
             else:
                 op = st.op
+                # sampling phase: draw tiny per-block samples while input
+                # bytes wait in the store; plan boundaries once input is
+                # complete (ref: sort_task_spec sampled range partitioning)
+                if op.sample_fn is not None and not st.planned:
+                    if st.t0 is None and st.inq:
+                        st.t0 = time.perf_counter()
+                    for idx, ref, _nb in st.inq:
+                        if (idx not in st.sampled
+                                and len(st.sample_inflight) < MAX_TASKS_PER_OP):
+                            out = self._remote(
+                                f"{i}:{st.name}.sample", op.sample_fn,
+                            ).remote(ref)
+                            st.sample_inflight[out] = idx
+                            st.sampled.add(idx)
+                    if (st.input_done and not st.sample_inflight
+                            and len(st.samples) == st.in_counter):
+                        st.ctx = op.plan_fn(
+                            [st.samples[j] for j in sorted(st.samples)])
+                        st.planned = True
+                if not st.planned:
+                    continue
                 # the map phase is not gated on downstream pressure: parts
                 # land in the (spillable) object store, not in driver queues
                 while st.inq and len(st.map_inflight) < MAX_TASKS_PER_OP:
                     idx, ref = st.pop_input()
                     if st.t0 is None:
                         st.t0 = time.perf_counter()
+                    extra = () if op.sample_fn is None else (st.ctx,)
                     if op.num_partitions == 1:
                         # num_returns=1 would store the whole 1-tuple as the
                         # result; unwrap in-task so reduce gets a block
                         parts = [self._remote(
                             f"{i}:{st.name}.map", _single_part_map,
-                        ).remote(ref, op.map_fn, idx)]
+                        ).remote(ref, op.map_fn, idx, *extra)]
                     else:
                         parts = self._remote(
                             f"{i}:{st.name}.map", op.map_fn,
                             num_returns=op.num_partitions,
-                        ).remote(ref, op.num_partitions, idx)
+                        ).remote(ref, op.num_partitions, idx, *extra)
                     st.map_inflight[parts[0]] = (idx, parts)
                 if (st.input_done and not st.inq and not st.map_inflight
                         and not st.reduce_started):
@@ -270,6 +340,10 @@ class StreamingExecutor:
                     s.buffer[idx] = (ref, sizes[ref])
                     s.note_out(sizes[ref])
             else:
+                for ref in [r for r in s.sample_inflight if r in ready_set]:
+                    idx = s.sample_inflight.pop(ref)
+                    # samples are tiny by contract; materialize at the driver
+                    s.samples[idx] = self._ray.get(ref)
                 for first in [r for r in s.map_inflight if r in ready_set]:
                     idx, parts = s.map_inflight.pop(first)
                     for p, pref in enumerate(parts):
@@ -318,9 +392,14 @@ class StreamingExecutor:
 def run_shuffle_inline(op: ShuffleOp, blocks: Iterator[pa.Table]):
     """Single-process execution of a ShuffleOp — identical partition/reduce
     semantics (same seed → same output as the task-parallel path)."""
+    extra = ()
+    if op.sample_fn is not None:
+        blocks = list(blocks)  # inline mode is single-process by definition
+        extra = (op.plan_fn([op.sample_fn(b) for b in blocks]),)
     parts = [[] for _ in range(op.num_partitions)]
     for idx, blk in enumerate(blocks):
-        for p, part in enumerate(op.map_fn(blk, op.num_partitions, idx)):
+        for p, part in enumerate(
+                op.map_fn(blk, op.num_partitions, idx, *extra)):
             parts[p].append(part)
     for p in range(op.num_partitions):
         out = op.reduce_fn(parts[p], p)

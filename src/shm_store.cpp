@@ -25,9 +25,10 @@
 
 namespace {
 
-constexpr uint64_t kMagic = 0x52545055534c4142ull;  // "RTPUSLAB"
+constexpr uint64_t kMagic = 0x52545055534c4143ull;  // "RTPUSLAC" (v2: pins)
 constexpr uint32_t kKeyLen = 31;
 constexpr uint32_t kTableSlots = 1 << 16;  // 64k objects
+constexpr uint32_t kPinSlots = 1 << 15;    // 32k live (pid, block) pin pairs
 constexpr uint64_t kAlign = 64;            // cache-line align payloads
 constexpr int64_t kNil = -1;
 
@@ -46,6 +47,25 @@ struct FreeBlock {
   int64_t next;    // offset of next free block (sorted ascending), or kNil
 };
 
+// While a block is ALLOCATED its 16-byte FreeBlock header is repurposed as
+// pin bookkeeping (plasma semantics: a freed-but-pinned object's memory must
+// not be reused while any process still maps a zero-copy view of it —
+// ref: plasma client Get pins, src/ray/object_manager/plasma/store.cc).
+struct BlockHdr {
+  uint64_t need;    // aligned total bytes INCLUDING this header
+  uint32_t pins;    // processes holding zero-copy views (lock-protected)
+  uint32_t zombie;  // freed while pinned: reclaim on last unpin
+};
+
+// Per-(pid, block) pin ledger, so a crashed client's pins can be reclaimed
+// by whoever reaps it (ref: plasma's per-client object release on
+// disconnect, src/ray/object_manager/plasma/store.cc DisconnectClient).
+struct PinRec {
+  int32_t pid;      // 0 = empty
+  uint32_t count;
+  int64_t offset;   // payload offset of the pinned block
+};
+
 struct Header {
   uint64_t magic;
   uint64_t capacity;    // total arena bytes
@@ -55,7 +75,26 @@ struct Header {
   pthread_mutex_t lock;
   int64_t free_head;    // offset of first free block
   Slot table[kTableSlots];
+  PinRec pin_table[kPinSlots];
 };
+
+// Find (or allocate, for_insert) the pin record for (pid, offset).
+// Open addressing with tombstones (pid!=0, count==0); nullptr when absent /
+// table full. Caller holds the lock.
+PinRec* find_pin(Header* hd, int32_t pid, int64_t offset, bool for_insert) {
+  uint64_t idx = (static_cast<uint64_t>(pid) * 2654435761ull
+                  ^ static_cast<uint64_t>(offset) * 1099511628211ull)
+                 & (kPinSlots - 1);
+  PinRec* first_reusable = nullptr;
+  for (uint32_t probe = 0; probe < kPinSlots; ++probe) {
+    PinRec* r = &hd->pin_table[(idx + probe) & (kPinSlots - 1)];
+    bool empty = (r->pid == 0 && r->count == 0);
+    if (r->count > 0 && r->pid == pid && r->offset == offset) return r;
+    if (r->count == 0 && !first_reusable) first_reusable = r;
+    if (empty) return for_insert ? first_reusable : nullptr;
+  }
+  return for_insert ? first_reusable : nullptr;
+}
 
 struct Handle {
   void* base;
@@ -129,6 +168,20 @@ void free_list_insert(Header* hd, char* base, int64_t off, uint64_t size) {
 
 // First-fit allocate `need` bytes (already including header+align). Returns
 // block offset or kNil.
+// Free a slot's block, or mark it zombie when zero-copy readers still pin
+// it (the last rt_store_unpin reclaims). Caller holds the lock.
+void release_block(Header* hd, char* base, Slot* s) {
+  int64_t blk = static_cast<int64_t>(s->offset) -
+                static_cast<int64_t>(sizeof(FreeBlock));
+  auto* bh = reinterpret_cast<BlockHdr*>(base + blk);
+  if (bh->pins > 0) {
+    bh->zombie = 1;
+    return;
+  }
+  free_list_insert(hd, base, blk,
+                   align_up(s->size + sizeof(FreeBlock), kAlign));
+}
+
 int64_t free_list_take(Header* hd, char* base, uint64_t need) {
   int64_t prev = kNil, cur = hd->free_head;
   while (cur != kNil) {
@@ -260,10 +313,8 @@ int64_t rt_store_alloc(void* hv, const char* key, uint64_t size) {
   uint64_t need = align_up(size + sizeof(FreeBlock), kAlign);
   lock(hd);
   Slot* existing = find_slot(hd, key, false);
-  if (existing) {  // overwrite semantics: free then re-alloc
-    free_list_insert(hd, base, static_cast<int64_t>(existing->offset) -
-                                   static_cast<int64_t>(sizeof(FreeBlock)),
-                     align_up(existing->size + sizeof(FreeBlock), kAlign));
+  if (existing) {  // overwrite semantics: free (or zombie) then re-alloc
+    release_block(hd, base, existing);
     hd->used -= existing->size;
     hd->num_objects--;
     existing->state = kTombstone;
@@ -284,6 +335,10 @@ int64_t rt_store_alloc(void* hv, const char* key, uint64_t size) {
   s->offset = static_cast<uint64_t>(blk) + sizeof(FreeBlock);
   s->size = size;
   s->state = kUsed;
+  auto* bh = reinterpret_cast<BlockHdr*>(base + blk);
+  bh->need = need;
+  bh->pins = 0;
+  bh->zombie = 0;
   hd->used += size;
   hd->num_objects++;
   unlock(hd);
@@ -315,14 +370,93 @@ int rt_store_free(void* hv, const char* key) {
     unlock(hd);
     return -1;
   }
-  free_list_insert(hd, base, static_cast<int64_t>(s->offset) -
-                                 static_cast<int64_t>(sizeof(FreeBlock)),
-                   align_up(s->size + sizeof(FreeBlock), kAlign));
+  release_block(hd, base, s);
   hd->used -= s->size;
   hd->num_objects--;
   s->state = kTombstone;
   unlock(hd);
   return 0;
+}
+
+// Look up `key` and take a pin in one critical section (a lookup-then-pin
+// pair would race with a concurrent free). Records the pin in the per-pid
+// ledger so a dead client's pins can be reclaimed. Returns payload offset,
+// or -1.
+int64_t rt_store_lookup_pin(void* hv, const char* key, uint64_t* size_out) {
+  auto* h = static_cast<Handle*>(hv);
+  auto* hd = header_of(h);
+  char* base = static_cast<char*>(h->base);
+  int32_t pid = static_cast<int32_t>(getpid());
+  lock(hd);
+  Slot* s = find_slot(hd, key, false);
+  if (!s) {
+    unlock(hd);
+    return -1;
+  }
+  if (size_out) *size_out = s->size;
+  int64_t off = static_cast<int64_t>(s->offset);
+  auto* bh = reinterpret_cast<BlockHdr*>(base + off -
+                                         static_cast<int64_t>(sizeof(FreeBlock)));
+  bh->pins++;
+  PinRec* r = find_pin(hd, pid, off, true);
+  if (r) {  // ledger full → pin still held, just not crash-reclaimable
+    r->pid = pid;
+    r->offset = off;
+    r->count++;
+  }
+  unlock(hd);
+  return off;
+}
+
+namespace {
+// Caller holds the lock.
+void unpin_block(Header* hd, char* base, int64_t offset) {
+  int64_t blk = offset - static_cast<int64_t>(sizeof(FreeBlock));
+  auto* bh = reinterpret_cast<BlockHdr*>(base + blk);
+  if (bh->pins > 0) bh->pins--;
+  if (bh->pins == 0 && bh->zombie) {
+    uint64_t need = bh->need;  // free_list_insert overwrites this header
+    free_list_insert(hd, base, blk, need);
+  }
+}
+}  // namespace
+
+// Drop a pin taken by rt_store_lookup_pin; reclaims a zombie block on the
+// last unpin. Safe after the object's slot is gone (offset-addressed).
+int rt_store_unpin(void* hv, int64_t offset) {
+  auto* h = static_cast<Handle*>(hv);
+  auto* hd = header_of(h);
+  char* base = static_cast<char*>(h->base);
+  int32_t pid = static_cast<int32_t>(getpid());
+  lock(hd);
+  unpin_block(hd, base, offset);
+  PinRec* r = find_pin(hd, pid, offset, false);
+  if (r && r->count > 0) r->count--;
+  unlock(hd);
+  return 0;
+}
+
+// Release EVERY pin held by `pid` (ref: plasma DisconnectClient). Called by
+// the controller when it reaps a dead worker, and by a client closing
+// cleanly with values still alive.
+int rt_store_release_pins(void* hv, int32_t pid) {
+  auto* h = static_cast<Handle*>(hv);
+  auto* hd = header_of(h);
+  char* base = static_cast<char*>(h->base);
+  int released = 0;
+  lock(hd);
+  for (uint32_t i = 0; i < kPinSlots; ++i) {
+    PinRec* r = &hd->pin_table[i];
+    if (r->pid == pid && r->count > 0) {
+      while (r->count > 0) {
+        unpin_block(hd, base, r->offset);
+        r->count--;
+        ++released;
+      }
+    }
+  }
+  unlock(hd);
+  return released;
 }
 
 uint64_t rt_store_used(void* hv) {

@@ -203,3 +203,31 @@ def test_task_storm_dispatch(ray_session):
         if i % 10 == 0:
             expect.append(-i)
     assert out == expect
+
+
+def test_zero_copy_value_survives_ref_release(ray_session):
+    """Plasma pin semantics (r4): a get() value aliases arena memory and
+    must stay intact after its ObjectRef is dropped and the object evicted —
+    the arena zombies pinned blocks instead of recycling their bytes.
+    Regression: streaming-shuffle blocks over the inline threshold silently
+    swapped content when their refs died before consumption."""
+    import gc
+    import numpy as np
+    ray = ray_session
+
+    @ray.remote
+    def make(i):
+        return np.full(50_000, i, np.int64)  # ~400KB -> shm path
+
+    vals = []
+    for i in range(6):
+        ref = make.remote(i)
+        vals.append(ray.get(ref, timeout=60))
+        del ref  # creation ref dropped -> object evictable
+    gc.collect()
+    # churn the arena so freed ranges would be recycled if unpinned
+    churn = [ray.get(make.remote(100 + i), timeout=60) for i in range(6)]
+    for i, v in enumerate(vals):
+        assert (v == i).all(), f"value {i} corrupted after ref release"
+    for i, v in enumerate(churn):
+        assert (v == 100 + i).all()
