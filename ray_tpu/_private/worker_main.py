@@ -81,8 +81,24 @@ def _resolve_args(ws, spec):
 
 def _call(ws, fn, args, kwargs):
     if inspect.iscoroutinefunction(fn):
+        import concurrent.futures
         loop = ws.get_async_loop()
-        return asyncio.run_coroutine_threadsafe(fn(*args, **kwargs), loop).result()
+        fut = asyncio.run_coroutine_threadsafe(fn(*args, **kwargs), loop)
+        try:
+            # Wait in short slices: a targeted cancel (ray_tpu.cancel →
+            # cancel_exec) raises KeyboardInterrupt in THIS thread via
+            # PyThreadState_SetAsyncExc, which only fires while bytecode
+            # runs — an indefinite C-level result() wait would never see it.
+            while True:
+                try:
+                    return fut.result(timeout=0.1)
+                except concurrent.futures.TimeoutError:
+                    continue
+        except KeyboardInterrupt:
+            # propagate into the coroutine so the replica's in-flight slot
+            # frees (asyncio.CancelledError inside the task)
+            fut.cancel()
+            raise
     return fn(*args, **kwargs)
 
 

@@ -9,11 +9,14 @@ from .api import delete, get_deployment_handle, run, shutdown, start, status
 from .batching import batch
 from .deployment import AutoscalingConfig, Deployment, DeploymentConfig, deployment
 from .handle import DeploymentHandle, DeploymentResponse
+from .multiplex import get_multiplexed_model_id, multiplexed
 from .proxy import Request, Response
+from .schema import build_app_config, deploy_config
 
 __all__ = [
     "AutoscalingConfig", "Deployment", "DeploymentConfig", "DeploymentHandle",
-    "DeploymentResponse", "Request", "Response", "batch", "delete",
-    "deployment", "get_deployment_handle", "run", "shutdown", "start",
+    "DeploymentResponse", "Request", "Response", "batch", "build_app_config",
+    "delete", "deploy_config", "deployment", "get_deployment_handle",
+    "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
     "status",
 ]

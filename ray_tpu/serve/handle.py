@@ -12,16 +12,50 @@ from typing import Any, Dict, List, Optional
 
 
 class DeploymentResponse:
-    """Future for one request (reference: serve.handle.DeploymentResponse)."""
+    """Future for one request (reference: serve.handle.DeploymentResponse).
 
-    def __init__(self, ref):
+    `cancel()` propagates to the replica: a running async method gets
+    asyncio-cancelled, freeing its in-flight slot (ref: serve request
+    cancellation). A handle-level `timeout_s` auto-cancels on expiry."""
+
+    def __init__(self, ref, timeout_s: Optional[float] = None):
         self._ref = ref
+        self._timeout_s = timeout_s
 
     def result(self, timeout_s: Optional[float] = None):
         import ray_tpu
-        return ray_tpu.get(self._ref, timeout=timeout_s)
+        timeout = timeout_s if timeout_s is not None else self._timeout_s
+        try:
+            return ray_tpu.get(self._ref, timeout=timeout)
+        except ray_tpu.exceptions.GetTimeoutError:
+            if timeout_s is None and self._timeout_s is not None:
+                # handle-configured deadline: the request is abandoned, so
+                # stop the replica-side work too
+                self.cancel()
+                raise TimeoutError(
+                    f"request timed out after {self._timeout_s}s "
+                    f"(cancelled)") from None
+            raise
+
+    def cancel(self):
+        import ray_tpu
+        ray_tpu.cancel(self._ref)
+
+    async def _await_with_deadline(self):
+        import asyncio
+        try:
+            return await asyncio.wait_for(self._await_ref(), self._timeout_s)
+        except asyncio.TimeoutError:
+            self.cancel()
+            raise TimeoutError(f"request timed out after {self._timeout_s}s "
+                               f"(cancelled)") from None
+
+    async def _await_ref(self):
+        return await self._ref
 
     def __await__(self):
+        if self._timeout_s is not None:
+            return self._await_with_deadline().__await__()
         return self._ref.__await__()
 
     @property
@@ -65,13 +99,20 @@ class DeploymentResponseGenerator:
 
 class DeploymentHandle:
     def __init__(self, deployment_name: str, app_name: str = "default",
-                 method_name: str = "__call__", stream: bool = False):
+                 method_name: str = "__call__", stream: bool = False,
+                 multiplexed_model_id: str = "",
+                 timeout_s: Optional[float] = None):
         self.deployment_name = deployment_name
         self.app_name = app_name
         self._method_name = method_name
         self._stream = stream
+        self._multiplexed_model_id = multiplexed_model_id
+        self._timeout_s = timeout_s
         self._replicas: List = []
         self._inflight: Dict[str, int] = {}
+        # model id -> replica idx sticky affinity (multiplex routing: keep a
+        # model's requests on the replica that already loaded it)
+        self._model_affinity: Dict[str, int] = {}
         # reentrant: stream-generator __del__ fires the decrement callback,
         # and cyclic GC can run while this thread already holds the lock
         self._lock = threading.RLock()
@@ -80,12 +121,20 @@ class DeploymentHandle:
 
     # -- construction / refresh ---------------------------------------------
     def options(self, *, method_name: Optional[str] = None,
-                stream: Optional[bool] = None, **_compat) -> "DeploymentHandle":
-        h = DeploymentHandle(self.deployment_name, self.app_name,
-                             method_name or self._method_name,
-                             self._stream if stream is None else stream)
+                stream: Optional[bool] = None,
+                multiplexed_model_id: Optional[str] = None,
+                timeout_s: Optional[float] = None,
+                **_compat) -> "DeploymentHandle":
+        h = DeploymentHandle(
+            self.deployment_name, self.app_name,
+            method_name or self._method_name,
+            self._stream if stream is None else stream,
+            (self._multiplexed_model_id if multiplexed_model_id is None
+             else multiplexed_model_id),
+            self._timeout_s if timeout_s is None else timeout_s)
         h._replicas = self._replicas
         h._inflight = self._inflight
+        h._model_affinity = self._model_affinity
         h._lock = self._lock  # shared counters need the shared lock
         h._version = self._version
         h._last_refresh = self._last_refresh
@@ -128,7 +177,19 @@ class DeploymentHandle:
         if not self._replicas:
             raise RuntimeError(
                 f"deployment '{self.deployment_name}' has no replicas")
-        idx = self._pick_replica()
+        model_id = self._multiplexed_model_id
+        if model_id:
+            # sticky multiplex routing: the replica that loaded this model
+            # keeps serving it (cache hit) until the replica set changes
+            with self._lock:
+                idx = self._model_affinity.get(model_id)
+            if idx is None or idx >= len(self._replicas):
+                idx = self._pick_replica()
+                with self._lock:
+                    self._model_affinity[model_id] = idx
+            kwargs = {**kwargs, "_rtpu_multiplexed_model_id": model_id}
+        else:
+            idx = self._pick_replica()
         replica = self._replicas[idx]
         with self._lock:
             self._inflight[idx] = self._inflight.get(idx, 0) + 1
@@ -146,7 +207,7 @@ class DeploymentHandle:
             ref.future().add_done_callback(_done)
         except Exception:  # noqa: BLE001 - counter decay is best-effort
             pass
-        return DeploymentResponse(ref)
+        return DeploymentResponse(ref, timeout_s=self._timeout_s)
 
     def __getattr__(self, item):
         if item.startswith("_"):
@@ -155,4 +216,6 @@ class DeploymentHandle:
 
     def __reduce__(self):
         return (DeploymentHandle, (self.deployment_name, self.app_name,
-                                   self._method_name, self._stream))
+                                   self._method_name, self._stream,
+                                   self._multiplexed_model_id,
+                                   self._timeout_s))

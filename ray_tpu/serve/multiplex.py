@@ -1,0 +1,109 @@
+"""Model multiplexing (reference: python/ray/serve/multiplex.py:22
+_ModelMultiplexWrapper + serve/api.py:926 @serve.multiplexed).
+
+One replica serves MANY models: the decorated loader is called per
+`model_id` and its results are LRU-cached (`max_num_models_per_replica`).
+Requests carry their model id via
+`handle.options(multiplexed_model_id=...)` (or the
+`serve_multiplexed_model_id` HTTP header through the proxy), and the
+deployment reads it with `serve.get_multiplexed_model_id()`.
+
+Routing contrast with the reference: the reference's router tracks which
+replicas hold which models cluster-wide; here each handle keeps model→
+replica affinity locally (sticky after first use), which converges to the
+same behavior without controller chatter on the request path.
+"""
+
+import asyncio
+import collections
+import contextvars
+import functools
+from typing import Any, Callable, Optional
+
+_current_model_id: contextvars.ContextVar = contextvars.ContextVar(
+    "rtpu_serve_multiplexed_model_id", default="")
+
+
+def get_multiplexed_model_id() -> str:
+    """The model id of the request being handled (ref:
+    serve.get_multiplexed_model_id); "" outside a multiplexed request."""
+    return _current_model_id.get()
+
+
+def _set_current_model_id(model_id: str):
+    _current_model_id.set(model_id)
+
+
+class _ModelCache:
+    """Per-replica LRU of loaded models; eviction calls the model's
+    `unload()`/`__del__` like the reference's wrapper."""
+
+    def __init__(self, loader: Callable, max_models: int):
+        self.loader = loader
+        self.max_models = max_models
+        self.models: "collections.OrderedDict[str, Any]" = \
+            collections.OrderedDict()
+        self._loading: dict = {}  # model_id -> asyncio.Future
+
+    async def get_model(self, owner, model_id: str):
+        if model_id in self.models:
+            self.models.move_to_end(model_id)
+            return self.models[model_id]
+        fut = self._loading.get(model_id)
+        if fut is not None:  # concurrent request for the same model: share
+            return await fut
+        loop = asyncio.get_running_loop()
+        fut = loop.create_future()
+        self._loading[model_id] = fut
+        try:
+            # evict BEFORE loading: if max_models models fill the device,
+            # holding N+1 during the load would OOM exactly when the cap is
+            # sized to the hardware
+            while len(self.models) >= self.max_models:
+                _old_id, old = self.models.popitem(last=False)
+                unload = getattr(old, "unload", None)
+                if callable(unload):
+                    maybe = unload()
+                    if asyncio.iscoroutine(maybe):
+                        await maybe
+                del old
+            out = self.loader(owner, model_id)
+            if asyncio.iscoroutine(out):
+                out = await out
+            self.models[model_id] = out
+            fut.set_result(out)
+            return out
+        except BaseException as e:  # noqa: BLE001 - propagate to all waiters
+            fut.set_exception(e)
+            raise
+        finally:
+            self._loading.pop(model_id, None)
+
+
+def multiplexed(func: Optional[Callable] = None, *,
+                max_num_models_per_replica: int = 3):
+    """Decorator for the model-loading method of a deployment:
+
+        @serve.deployment
+        class Translator:
+            @serve.multiplexed(max_num_models_per_replica=4)
+            async def get_model(self, model_id: str):
+                return load_weights(model_id)
+
+            async def __call__(self, request):
+                model = await self.get_model(serve.get_multiplexed_model_id())
+                return model(request.body)
+    """
+    def wrap(loader: Callable):
+        cache = _ModelCache(loader, max_num_models_per_replica)
+
+        @functools.wraps(loader)
+        async def inner(self, model_id: str):
+            return await cache.get_model(self, model_id)
+
+        inner.__rtpu_multiplexed__ = cache
+        return inner
+
+    if func is not None:
+        return wrap(func)
+    return wrap

@@ -341,6 +341,9 @@ class ProxyActor:
 
     async def _respond_unary(self, writer, app, deployment, req):
         handle = self._handle_for(app, deployment)
+        model_id = req.headers.get("serve_multiplexed_model_id", "")
+        if model_id:  # multiplex routing rides the reference's header name
+            handle = handle.options(multiplexed_model_id=model_id)
         loop = asyncio.get_running_loop()
         # handle.remote() talks to the serve controller (blocking client IO);
         # run it and the result fetch on the proxy pool so slow replicas
@@ -352,6 +355,9 @@ class ProxyActor:
 
     async def _respond_streaming(self, writer, app, deployment, req):
         handle = self._handle_for(app, deployment).options(stream=True)
+        model_id = req.headers.get("serve_multiplexed_model_id", "")
+        if model_id:
+            handle = handle.options(multiplexed_model_id=model_id)
         loop = asyncio.get_running_loop()
         # errors before the head is written surface as a normal 500
         gen = await loop.run_in_executor(self._pool, handle.remote, req)

@@ -30,10 +30,19 @@ class Replica:
         if fn is not None:
             fn(user_config)
 
+    @staticmethod
+    def _set_request_context(kwargs):
+        model_id = kwargs.pop("_rtpu_multiplexed_model_id", None)
+        if model_id is not None:
+            from .multiplex import _set_current_model_id
+            _set_current_model_id(model_id)
+        return kwargs
+
     async def handle_request(self, method_name, *args, **kwargs):
         self._ongoing += 1
         self._total += 1
         try:
+            kwargs = self._set_request_context(kwargs)
             fn = getattr(self.instance, method_name)
             out = fn(*args, **kwargs)
             if inspect.iscoroutine(out):
@@ -47,6 +56,7 @@ class Replica:
         self._ongoing += 1
         self._total += 1
         try:
+            kwargs = self._set_request_context(kwargs)
             fn = getattr(self.instance, method_name)
             out = fn(*args, **kwargs)
             if inspect.isasyncgen(out):

@@ -165,6 +165,37 @@ class Tuner:
         self.param_space = param_space or {}
         self.tune_config = tune_config or TuneConfig()
         self.run_config = run_config or RunConfig(name="tune")
+        self._restore_state: Optional[Dict] = None
+
+    @classmethod
+    def restore(cls, path: str, trainable: Callable, *,
+                param_space: Optional[Dict] = None,
+                tune_config: Optional[TuneConfig] = None,
+                run_config: Optional[RunConfig] = None) -> "Tuner":
+        """Resume a crashed/killed sweep from its experiment directory
+        (reference: python/ray/tune/tuner.py:135 Tuner.restore). Finished
+        trials are kept as-is; trials that were in flight re-run (from
+        their last checkpoint when one exists); the remaining sample budget
+        continues. The searcher is replayed deterministically — seeded
+        searchers reproduce their suggestion stream exactly.
+
+        `fit()` journals experiment state to `<experiment_dir>/tuner.json`
+        continuously, so restore works after any interruption."""
+        import json
+        state_path = os.path.join(path, "tuner.json")
+        with open(state_path) as f:
+            state = json.load(f)
+        rc = run_config or RunConfig(name=os.path.basename(path.rstrip("/")),
+                                     storage_path=os.path.dirname(
+                                         path.rstrip("/")))
+        tuner = cls(trainable, param_space=param_space,
+                    tune_config=tune_config, run_config=rc)
+        tuner._restore_state = state
+        return tuner
+
+    @staticmethod
+    def can_restore(path: str) -> bool:
+        return os.path.exists(os.path.join(path, "tuner.json"))
 
     def fit(self) -> ResultGrid:
         import ray_tpu
@@ -192,9 +223,11 @@ class Tuner:
         exhausted = False
         counter = [0]
 
-        def launch(config: Dict, resume_from=None, id_suffix="") -> _Trial:
-            tid = f"trial_{counter[0]:05d}{id_suffix}"
-            counter[0] += 1
+        def launch(config: Dict, resume_from=None, id_suffix="",
+                   tid: Optional[str] = None) -> _Trial:
+            if tid is None:
+                tid = f"trial_{counter[0]:05d}{id_suffix}"
+                counter[0] += 1
             t = _Trial(trial_id=tid, config=config,
                        dir=os.path.join(exp_dir, tid),
                        resume_from=resume_from)
@@ -207,6 +240,63 @@ class Tuner:
             trials.append(t)
             return t
 
+        _last_save = [0.0]
+
+        def save_state(force: bool = False):
+            """Journal the experiment (atomic rewrite, throttled to ~1 Hz —
+            rewriting full history at poll rate would dominate the loop) so
+            Tuner.restore can resume after a crash (ref: tune experiment
+            checkpointing)."""
+            import json
+            now = time.monotonic()
+            if not force and now - _last_save[0] < 1.0:
+                return
+            _last_save[0] = now
+            recs = []
+            for t in trials:
+                recs.append({
+                    "trial_id": t.trial_id, "config": t.config,
+                    "state": t.state, "results": t.results,
+                    "last_ckpt_dir": t.last_ckpt_dir, "error": t.error,
+                    "resume_from": t.resume_from,
+                })
+            blob = json.dumps({"counter": counter[0], "trials": recs,
+                               "exhausted": exhausted}, default=str)
+            tmp = os.path.join(exp_dir, "tuner.json.tmp")
+            os.makedirs(exp_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                f.write(blob)
+            os.replace(tmp, os.path.join(exp_dir, "tuner.json"))
+
+        pending_restore: List[_Trial] = []
+        if self._restore_state is not None:
+            # Replay the journal in suggestion order: the searcher re-sees
+            # suggest (+complete for finished trials), so seeded suggestion
+            # streams stay aligned; the journaled config is the truth either
+            # way. PBT-exploit trials (id suffix _pbt) never consumed a
+            # suggestion originally, so they are not replayed through the
+            # searcher. Unfinished trials relaunch from their last
+            # checkpoint — via the MAIN loop, under max_concurrent_trials.
+            for rec in self._restore_state["trials"]:
+                if not rec["trial_id"].endswith("_pbt"):
+                    searcher.suggest(rec["trial_id"])  # advance the stream
+                if rec["state"] in ("TERMINATED", "ERROR"):
+                    t = _Trial(trial_id=rec["trial_id"], config=rec["config"],
+                               state=rec["state"],
+                               dir=os.path.join(exp_dir, rec["trial_id"]))
+                    t.results = list(rec["results"])
+                    t.last_ckpt_dir = rec["last_ckpt_dir"]
+                    t.error = rec["error"]
+                    trials.append(t)
+                    searcher.on_trial_complete(
+                        t.trial_id, t.results[-1] if t.results else None)
+                else:
+                    t = _Trial(trial_id=rec["trial_id"], config=rec["config"],
+                               dir=os.path.join(exp_dir, rec["trial_id"]),
+                               resume_from=rec["last_ckpt_dir"])
+                    pending_restore.append(t)
+            counter[0] = max(counter[0], self._restore_state["counter"])
+
         def limited(s) -> bool:
             """ConcurrencyLimiter backpressure (None ≠ exhausted)."""
             return (hasattr(s, "max_concurrent")
@@ -214,8 +304,14 @@ class Tuner:
 
         while True:
             running = [t for t in trials if t.state == "RUNNING"]
+            # restored in-flight trials relaunch first, under the same cap
+            while pending_restore and len(running) < tc.max_concurrent_trials:
+                t = pending_restore.pop(0)
+                launch(t.config, resume_from=t.resume_from, tid=t.trial_id)
+                running = [t for t in trials if t.state == "RUNNING"]
             # launch new trials up to the concurrency cap
-            while not exhausted and len(running) < tc.max_concurrent_trials:
+            while (not exhausted and not pending_restore
+                   and len(running) < tc.max_concurrent_trials):
                 cfg = searcher.suggest(f"trial_{counter[0]:05d}")
                 if cfg is None:
                     if limited(searcher):
@@ -225,8 +321,9 @@ class Tuner:
                 launch(cfg)
                 running = [t for t in trials if t.state == "RUNNING"]
 
-            if not running and (exhausted or not any(
-                    t.state == "PENDING" for t in trials)):
+            if (not running and not pending_restore
+                    and (exhausted or not any(
+                        t.state == "PENDING" for t in trials))):
                 break
 
             # poll running trials
@@ -292,8 +389,10 @@ class Tuner:
                         ray_tpu.kill(t.actor)
                     except Exception:  # noqa: BLE001
                         pass
+            save_state()
             time.sleep(0.02)
 
+        save_state(force=True)
         results = [
             TrialResult(
                 trial_id=t.trial_id, config=t.config,

@@ -1,0 +1,174 @@
+"""Serve production surface: multiplexing, request cancellation/timeouts,
+declarative config deploy (VERDICT r3 missing #3; ref: serve/multiplex.py,
+serve request cancellation, serve/schema.py + `serve deploy`)."""
+
+import asyncio
+import json
+import os
+import textwrap
+import time
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def serve_app():
+    import ray_tpu
+    import ray_tpu.serve as serve
+    ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
+    yield serve
+    serve.shutdown()
+
+
+def test_multiplexed_lru_and_affinity(serve_app):
+    serve = serve_app
+    import ray_tpu
+
+    @serve.deployment
+    class MultiModel:
+        def __init__(self):
+            self.loads = []
+
+        @serve.multiplexed(max_num_models_per_replica=2)
+        async def get_model(self, model_id: str):
+            self.loads.append(model_id)
+            return {"id": model_id, "scale": len(model_id)}
+
+        async def __call__(self, x):
+            mid = serve.get_multiplexed_model_id()
+            model = await self.get_model(mid)
+            return {"model": model["id"], "out": x * model["scale"],
+                    "loads": list(self.loads)}
+
+    serve.run(MultiModel.bind(), name="mux", route_prefix="/mux")
+    h = serve.get_deployment_handle("MultiModel", "mux")
+
+    # same model twice: loaded once (LRU hit)
+    r1 = h.options(multiplexed_model_id="aa").remote(1).result(timeout_s=60)
+    r2 = h.options(multiplexed_model_id="aa").remote(2).result(timeout_s=60)
+    assert r1["model"] == "aa" and r1["out"] == 2
+    assert r2["out"] == 4
+    assert r2["loads"].count("aa") == 1
+
+    # third model evicts the LRU one; re-requesting it reloads
+    h.options(multiplexed_model_id="bbb").remote(1).result(timeout_s=60)
+    h.options(multiplexed_model_id="cccc").remote(1).result(timeout_s=60)
+    r5 = h.options(multiplexed_model_id="aa").remote(5).result(timeout_s=60)
+    assert r5["out"] == 10
+    assert r5["loads"].count("aa") == 2  # evicted by cccc, reloaded
+    serve.delete("mux")
+
+
+def test_request_cancellation_frees_slot(serve_app):
+    serve = serve_app
+    import ray_tpu
+
+    @serve.deployment(max_ongoing_requests=1)
+    class Slow:
+        def __init__(self):
+            self.cancelled = 0
+
+        async def hang(self):
+            try:
+                await asyncio.sleep(300)
+            except asyncio.CancelledError:
+                self.cancelled += 1
+                raise
+            return "never"
+
+        async def quick(self):
+            return {"cancelled": self.cancelled}
+
+    serve.run(Slow.bind(), name="slow", route_prefix="/slow")
+    h = serve.get_deployment_handle("Slow", "slow")
+
+    resp = h.options(method_name="hang").remote()
+    time.sleep(1.0)  # the hang is in flight on the replica
+    resp.cancel()
+    with pytest.raises(Exception) as ei:
+        resp.result(timeout_s=60)
+    assert "ancel" in type(ei.value).__name__ or "ancel" in str(ei.value)
+    # the replica slot freed: a quick call completes and saw the cancel
+    out = h.options(method_name="quick").remote().result(timeout_s=60)
+    assert out == {"cancelled": 1}
+    serve.delete("slow")
+
+
+def test_handle_timeout_cancels(serve_app):
+    serve = serve_app
+
+    @serve.deployment
+    class Sleepy:
+        async def __call__(self):
+            await asyncio.sleep(300)
+
+    serve.run(Sleepy.bind(), name="sleepy", route_prefix="/sleepy")
+    h = serve.get_deployment_handle("Sleepy", "sleepy")
+    t0 = time.time()
+    with pytest.raises(TimeoutError):
+        h.options(timeout_s=2).remote().result()
+    assert time.time() - t0 < 30
+    serve.delete("sleepy")
+
+
+def test_config_deploy_roundtrip(serve_app, tmp_path):
+    """YAML config → deploy_config → live app with overrides applied; and
+    build_app_config emits a config that re-deploys the same app."""
+    serve = serve_app
+    import sys
+    import yaml
+
+    # a real importable module for import_path resolution
+    mod_dir = tmp_path / "cfgmod"
+    mod_dir.mkdir()
+    (mod_dir / "myapp.py").write_text(textwrap.dedent("""
+        import ray_tpu.serve as serve
+
+        @serve.deployment
+        class Echo:
+            def __init__(self, prefix="x"):
+                self.prefix = prefix
+                self.cfg = {}
+            def reconfigure(self, user_config):
+                self.cfg = dict(user_config)
+            def __call__(self, request):
+                return {"prefix": self.prefix, "cfg": self.cfg}
+
+        app = Echo.bind("hello")
+
+        def builder(prefix="built"):
+            return Echo.bind(prefix)
+    """))
+    sys.path.insert(0, str(mod_dir))
+    try:
+        cfg = {
+            "applications": [
+                {"name": "a1", "import_path": "myapp:app",
+                 "route_prefix": "/a1",
+                 "deployments": [{"name": "Echo", "num_replicas": 2,
+                                  "user_config": {"beam": 4}}]},
+                {"name": "a2", "import_path": "myapp:builder",
+                 "args": {"prefix": "fromargs"}},
+            ]}
+        cfg_path = tmp_path / "serve.yaml"
+        cfg_path.write_text(yaml.safe_dump(cfg))
+
+        handles = serve.deploy_config(str(cfg_path), start_http=False)
+        assert set(handles) == {"a1", "a2"}
+        out1 = handles["a1"].remote(None).result(timeout_s=60)
+        assert out1 == {"prefix": "hello", "cfg": {"beam": 4}}
+        out2 = handles["a2"].remote(None).result(timeout_s=60)
+        assert out2["prefix"] == "fromargs"
+        st = serve.status()
+        assert st["a1:Echo"]["replicas"] == 2, st
+
+        # build emits a config that round-trips
+        import myapp
+        built = serve.build_app_config(myapp.app, "myapp:app", name="a3",
+                                       route_prefix="/a3")
+        handles3 = serve.deploy_config(built, start_http=False)
+        assert handles3["a3"].remote(None).result(timeout_s=60)["prefix"] == "hello"
+        for name in ("a1", "a2", "a3"):
+            serve.delete(name)
+    finally:
+        sys.path.remove(str(mod_dir))
