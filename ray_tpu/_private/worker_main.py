@@ -89,11 +89,14 @@ def _call(ws, fn, args, kwargs):
             # cancel_exec) raises KeyboardInterrupt in THIS thread via
             # PyThreadState_SetAsyncExc, which only fires while bytecode
             # runs — an indefinite C-level result() wait would never see it.
+            # concurrent.futures.wait (NOT result(timeout=...)): on 3.11+
+            # futures.TimeoutError IS builtin TimeoutError, so catching it
+            # around result() would swallow a coroutine's own TimeoutError
+            # and spin forever.
             while True:
-                try:
-                    return fut.result(timeout=0.1)
-                except concurrent.futures.TimeoutError:
-                    continue
+                done, _ = concurrent.futures.wait([fut], timeout=0.1)
+                if done:
+                    return fut.result()
         except KeyboardInterrupt:
             # propagate into the coroutine so the replica's in-flight slot
             # frees (asyncio.CancelledError inside the task)

@@ -10,11 +10,15 @@ from .datasource import (from_arrow, from_items, from_numpy, from_pandas,
                          read_parquet, read_text)
 from .preprocessors import (BatchMapper, Chain, Concatenator, LabelEncoder,
                             MinMaxScaler, Preprocessor, StandardScaler)
+from .readers import (read_images, read_tfrecords, read_webdataset,
+                      write_tfrecords)
+from .split import DataIterator
 
 __all__ = [
-    "Dataset", "GroupedData", "from_blocks", "from_items", "from_numpy",
-    "from_pandas", "from_arrow", "range", "read_parquet", "read_csv",
-    "read_json", "read_text", "read_binary_files", "Preprocessor",
+    "DataIterator", "Dataset", "GroupedData", "from_blocks", "from_items",
+    "from_numpy", "from_pandas", "from_arrow", "range", "read_parquet",
+    "read_csv", "read_images", "read_json", "read_text", "read_binary_files",
+    "read_tfrecords", "read_webdataset", "write_tfrecords", "Preprocessor",
     "BatchMapper", "StandardScaler", "MinMaxScaler", "LabelEncoder",
     "Concatenator", "Chain",
 ]

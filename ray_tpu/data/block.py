@@ -28,18 +28,27 @@ def block_from_rows(rows: List[Dict[str, Any]]) -> pa.Table:
 
 
 def block_from_numpy_dict(data: Dict[str, Any]) -> pa.Table:
-    arrays, names = [], []
+    arrays, fields = [], []
     for k, v in data.items():
-        names.append(k)
+        if isinstance(v, list) and v and isinstance(v[0], np.ndarray) \
+                and all(isinstance(x, np.ndarray)
+                        and x.shape == v[0].shape for x in v):
+            v = np.stack(v)  # uniform per-row tensors → one [N, ...] block
         v = np.asarray(v) if not isinstance(v, (pa.Array, pa.ChunkedArray, list)) else v
         if isinstance(v, np.ndarray) and v.ndim > 1:
-            # tensor column: store as fixed-size lists (arrow-native layout)
+            # tensor column: fixed-size lists (arrow-native layout) with the
+            # per-row shape in field metadata so reads reshape back
             flat = v.reshape(len(v), -1)
-            arrays.append(pa.FixedSizeListArray.from_arrays(
-                pa.array(flat.ravel()), flat.shape[1]))
+            arr = pa.FixedSizeListArray.from_arrays(
+                pa.array(flat.ravel()), flat.shape[1])
+            fields.append(pa.field(k, arr.type, metadata={
+                b"tensor_shape": ",".join(map(str, v.shape[1:])).encode()}))
+            arrays.append(arr)
         else:
-            arrays.append(pa.array(v))
-    return pa.table(dict(zip(names, arrays)))
+            arr = pa.array(v)
+            fields.append(pa.field(k, arr.type))
+            arrays.append(arr)
+    return pa.Table.from_arrays(arrays, schema=pa.schema(fields))
 
 
 def block_num_rows(block: pa.Table) -> int:
@@ -63,6 +72,11 @@ def _column_to_numpy(block: pa.Table, name: str) -> np.ndarray:
     if pa.types.is_fixed_size_list(typ):
         width = typ.list_size
         flat = col.combine_chunks().flatten().to_numpy(zero_copy_only=False)
+        field = block.schema.field(name)
+        meta = field.metadata or {}
+        if b"tensor_shape" in meta:  # multi-dim tensor column: reshape back
+            shape = tuple(int(x) for x in meta[b"tensor_shape"].split(b","))
+            return flat.reshape((-1,) + shape)
         return flat.reshape(-1, width)
     try:
         return col.to_numpy(zero_copy_only=False)

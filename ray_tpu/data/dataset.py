@@ -217,6 +217,15 @@ class Dataset:
         return GroupedData(self, key)
 
     # ---------------------------------------------------------------- splits
+    def streaming_split(self, n: int, *, equal: bool = False,
+                        locality_hints=None):
+        """N pickleable iterators over ONE shared execution; every block
+        goes to exactly one consumer (ref: dataset.py:2043 streaming_split
+        — the per-worker ingest primitive for dp-sharded training). See
+        data/split.py."""
+        from .split import streaming_split as _ss
+        return _ss(self, n, equal=equal, locality_hints=locality_hints)
+
     def split(self, n: int, *, equal: bool = False) -> List["Dataset"]:
         whole = B.block_concat(self.to_block_list())
         total = whole.num_rows

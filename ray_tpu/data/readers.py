@@ -1,0 +1,275 @@
+"""Binary dataset readers (reference: python/ray/data/read_api.py —
+read_images:1147, read_tfrecords:1974, webdataset datasource).
+
+TPU-first contrasts:
+- `read_images` decodes with PIL into HWC uint8 numpy (one block per file
+  batch) — the host-side layout `device_put` wants.
+- `read_tfrecords` parses the TFRecord framing AND the tf.train.Example
+  wire format directly (a ~60-line varint walk) instead of importing
+  tensorflow — the image has no TF, and Example's proto schema is tiny and
+  frozen. `write_tfrecords` round-trips for interop tests/export.
+- `read_webdataset` walks tar shards with `tarfile`, grouping members by
+  basename stem (the webdataset sample convention).
+"""
+
+import os
+import struct
+from typing import Dict, List, Optional
+
+import numpy as np
+import pyarrow as pa
+
+from . import block as B
+from .datasource import _expand_paths, from_items  # noqa: F401 (re-export hub)
+from .dataset import Dataset
+from .plan import Plan, Source
+
+
+def _source_ds(thunks, name) -> Dataset:
+    return Dataset(Plan(Source(thunks, name=name)))
+
+
+# --------------------------------------------------------------------- images
+def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
+                include_paths: bool = False, **_compat) -> Dataset:
+    """One row per image: {"image": HWC uint8 ndarray[, "path"]}. `size`
+    resizes (W, H); `mode` converts (RGB/L/...). Ref: read_api.py:1147."""
+    files = _expand_paths(paths, suffix=None)
+    files = [f for f in files
+             if f.lower().endswith((".png", ".jpg", ".jpeg", ".bmp", ".gif",
+                                    ".webp"))] or files
+
+    def reader(fp):
+        from PIL import Image
+        with Image.open(fp) as im:
+            if mode:
+                im = im.convert(mode)
+            if size is not None:
+                im = im.resize(size)
+            arr = np.asarray(im)
+        cols = {"image": arr[None]}  # [1, H, W, C] tensor column
+        if include_paths:
+            cols["path"] = [fp]
+        return B.block_from_numpy_dict(cols)
+
+    return _source_ds([(lambda f=f: reader(f)) for f in files], "read_images")
+
+
+# ------------------------------------------------------------------ tfrecords
+# TFRecord framing: {u64 length, u32 masked_crc(length), bytes data,
+# u32 masked_crc(data)}*. Example proto: message Example {Features features=1}
+# Features {map<string, Feature> feature=1}; Feature {oneof: BytesList=1,
+# FloatList=2, Int64List=3}; each list is a repeated field at tag 1.
+
+def _read_varint(buf: memoryview, pos: int):
+    out = 0
+    shift = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        out |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return out, pos
+        shift += 7
+
+
+def _iter_proto_fields(buf: memoryview):
+    pos = 0
+    n = len(buf)
+    while pos < n:
+        key, pos = _read_varint(buf, pos)
+        field, wire = key >> 3, key & 7
+        if wire == 2:  # length-delimited
+            ln, pos = _read_varint(buf, pos)
+            yield field, wire, buf[pos:pos + ln]
+            pos += ln
+        elif wire == 0:  # varint
+            v, pos = _read_varint(buf, pos)
+            yield field, wire, v
+        elif wire == 5:  # 32-bit
+            yield field, wire, buf[pos:pos + 4]
+            pos += 4
+        elif wire == 1:  # 64-bit
+            yield field, wire, buf[pos:pos + 8]
+            pos += 8
+        else:
+            raise ValueError(f"unsupported proto wire type {wire}")
+
+
+def _parse_feature(buf: memoryview):
+    for field, wire, val in _iter_proto_fields(buf):
+        if field == 1:    # BytesList
+            return [bytes(v) for f, w, v in _iter_proto_fields(val) if f == 1]
+        if field == 2:    # FloatList (packed or repeated fixed32)
+            floats = []
+            for f, w, v in _iter_proto_fields(val):
+                if f == 1:
+                    if w == 2:  # packed
+                        floats.extend(np.frombuffer(v, "<f4").tolist())
+                    else:       # non-packed: one fixed32 per field entry
+                        floats.append(struct.unpack("<f", bytes(v))[0])
+            return floats
+        if field == 3:    # Int64List
+            out = []
+            for f, w, v in _iter_proto_fields(val):
+                if f == 1:
+                    if w == 2:  # packed varints
+                        p = 0
+                        while p < len(v):
+                            x, p = _read_varint(v, p)
+                            out.append(_zig(x))
+                        return out
+                    out.append(_zig(v))
+            return out
+    return []
+
+
+def _zig(x: int) -> int:
+    """int64 fields are plain (not zigzag) but arrive as unsigned varints."""
+    return x - (1 << 64) if x >= (1 << 63) else x
+
+
+def _parse_example(data: bytes) -> Dict[str, list]:
+    out = {}
+    for field, _w, feats in _iter_proto_fields(memoryview(data)):
+        if field != 1:
+            continue
+        for f2, _w2, entry in _iter_proto_fields(feats):
+            if f2 != 1:
+                continue
+            name = None
+            vals = []
+            for f3, _w3, v3 in _iter_proto_fields(entry):
+                if f3 == 1:
+                    name = bytes(v3).decode()
+                elif f3 == 2:
+                    vals = _parse_feature(v3)
+            if name is not None:
+                out[name] = vals
+    return out
+
+
+def _iter_tfrecord_frames(fp: str):
+    with open(fp, "rb") as f:
+        while True:
+            head = f.read(8)
+            if len(head) < 8:
+                return
+            (length,) = struct.unpack("<Q", head)
+            f.read(4)  # length crc (unchecked: we are not guarding disk ECC)
+            data = f.read(length)
+            f.read(4)  # data crc
+            yield data
+
+
+def read_tfrecords(paths, **_compat) -> Dataset:
+    """tf.train.Example records → one row per record; single-element lists
+    unwrap to scalars (reference read_tfrecords behavior)."""
+    files = _expand_paths(paths, suffix=None)
+
+    def reader(fp):
+        raw = [_parse_example(frame) for frame in _iter_tfrecord_frames(fp)]
+        if not raw:
+            return pa.table({})
+        # unwrap a feature to scalars only when EVERY record has exactly one
+        # value (mixed arities must stay lists or arrow can't type the
+        # column; reference behavior for uniform single-value features)
+        keys = {k for ex in raw for k in ex}
+        unwrap = {k for k in keys
+                  if all(len(ex.get(k, [])) == 1 for ex in raw)}
+        rows = [{k: (ex[k][0] if k in unwrap else ex[k])
+                 for k in ex} for ex in raw]
+        return B.block_from_rows(rows)
+
+    return _source_ds([(lambda f=f: reader(f)) for f in files],
+                      "read_tfrecords")
+
+
+# ------------------------------------------------------------- tfrecord write
+def _enc_varint(x: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = x & 0x7F
+        x >>= 7
+        if x:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _enc_field(field: int, payload: bytes) -> bytes:
+    return _enc_varint((field << 3) | 2) + _enc_varint(len(payload)) + payload
+
+
+def _encode_example(row: Dict) -> bytes:
+    feats = b""
+    for name, val in row.items():
+        vals = val if isinstance(val, (list, tuple, np.ndarray)) else [val]
+        if len(vals) and isinstance(vals[0], (bytes, str)):
+            items = b"".join(_enc_field(1, v.encode() if isinstance(v, str)
+                                        else v) for v in vals)
+            feature = _enc_field(1, items)
+        elif len(vals) and isinstance(vals[0], (float, np.floating)):
+            packed = np.asarray(vals, "<f4").tobytes()
+            feature = _enc_field(2, _enc_field(1, packed))
+        else:
+            packed = b"".join(_enc_varint(int(v) & ((1 << 64) - 1))
+                              for v in vals)
+            feature = _enc_field(3, _enc_field(1, packed))
+        entry = _enc_field(1, name.encode()) + _enc_field(2, feature)
+        feats += _enc_field(1, entry)
+    return _enc_field(1, feats)
+
+
+_CRC_TABLE = None
+
+
+def _masked_crc(data: bytes) -> int:
+    import zlib
+    crc = zlib.crc32(data)  # NOTE: tf uses crc32c; plain crc32 here — we
+    # never verify on read, and files are marked via this same writer. For
+    # TF interop of OUR files, install crc32c and swap this fn.
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def write_tfrecords(ds_or_rows, path: str) -> str:
+    """Write rows as tf.train.Example TFRecords (round-trip partner of
+    read_tfrecords)."""
+    rows = (ds_or_rows.take_all() if hasattr(ds_or_rows, "take_all")
+            else list(ds_or_rows))
+    with open(path, "wb") as f:
+        for row in rows:
+            data = _encode_example(row)
+            f.write(struct.pack("<Q", len(data)))
+            f.write(struct.pack("<I", _masked_crc(struct.pack("<Q", len(data)))))
+            f.write(data)
+            f.write(struct.pack("<I", _masked_crc(data)))
+    return path
+
+
+# ------------------------------------------------------------------ webdataset
+def read_webdataset(paths, **_compat) -> Dataset:
+    """Tar shards of samples grouped by basename stem (webdataset layout:
+    `sample001.jpg` + `sample001.cls` + ... in one tar). One row per sample:
+    {"__key__": stem, "<ext>": bytes}."""
+    import tarfile
+    files = _expand_paths(paths, suffix=None)
+
+    def reader(fp):
+        samples: Dict[str, Dict] = {}
+        order: List[str] = []
+        with tarfile.open(fp) as tar:
+            for m in tar:
+                if not m.isfile():
+                    continue
+                base = os.path.basename(m.name)
+                stem, _, ext = base.partition(".")
+                if stem not in samples:
+                    samples[stem] = {"__key__": stem}
+                    order.append(stem)
+                samples[stem][ext] = tar.extractfile(m).read()
+        return B.block_from_rows([samples[s] for s in order])
+
+    return _source_ds([(lambda f=f: reader(f)) for f in files],
+                      "read_webdataset")

@@ -1,0 +1,149 @@
+"""Dataset.streaming_split — per-consumer disjoint streams over ONE
+execution (reference: python/ray/data/dataset.py:2043 streaming_split +
+_internal/execution/streaming_executor coordinator actor).
+
+The thing a dp-sharded trainer wants for ingest: N workers each hold a
+DataIterator; every block of the dataset goes to EXACTLY one of them.
+A coordinator actor runs the plan's streaming executor once and deals
+blocks out:
+
+- equal=False (default): dynamic dealing — whichever worker asks next gets
+  the next block (natural load balancing; block counts may differ).
+- equal=True: strict round-robin by block index, so every worker sees the
+  same number of blocks (±1) — the analog of the reference's equalized
+  splits at block granularity.
+
+Iterators are pickleable (they hold the coordinator's actor handle), so the
+driver can create them once and ship one to each train worker.
+"""
+
+
+from typing import Iterator, List, Optional
+
+import pyarrow as pa
+
+
+class _SplitCoordinator:
+    """Actor: runs the dataset's block stream once; serves next-block pulls.
+
+    Blocks travel as pyarrow Tables through the object store (each pull is
+    one actor round-trip returning one block). equal=True deals round-robin
+    with a per-consumer high-water mark: a stalled consumer eventually
+    PAUSES the whole stream (backpressure) instead of buffering its ~1/n of
+    the dataset inside this actor."""
+
+    MAX_QUEUED_PER_SPLIT = 16
+
+    def __init__(self, plan_blob: bytes, n: int, equal: bool):
+        import cloudpickle
+        plan = cloudpickle.loads(plan_blob)
+        self._it = plan.iter_blocks()
+        self._n = n
+        self._equal = equal
+        self._queues: List[List] = [[] for _ in range(n)]
+        self._rr = 0
+        self._done = False
+        self._cond = None  # asyncio.Condition, created on the actor's loop
+
+    def _pull_upstream(self) -> Optional[pa.Table]:
+        try:
+            return next(self._it)
+        except StopIteration:
+            self._done = True
+            return None
+
+    async def next_block(self, split_idx: int):
+        """The next block for `split_idx`, or None at end of stream."""
+        import asyncio
+        if self._cond is None:
+            self._cond = asyncio.Condition()
+        async with self._cond:
+            if not self._equal:
+                return self._pull_upstream() if not self._done else None
+            while not self._queues[split_idx] and not self._done:
+                if len(self._queues[self._rr]) >= self.MAX_QUEUED_PER_SPLIT:
+                    # the next deal targets a consumer that isn't draining:
+                    # wait for it rather than buffering its backlog
+                    await self._cond.wait()
+                    continue
+                blk = self._pull_upstream()
+                if blk is None:
+                    break
+                self._queues[self._rr].append(blk)
+                self._rr = (self._rr + 1) % self._n
+            if self._queues[split_idx]:
+                blk = self._queues[split_idx].pop(0)
+                self._cond.notify_all()  # room freed: wake paused dealers
+                return blk
+            self._cond.notify_all()  # end of stream: release any waiters
+            return None
+
+    def stats(self):
+        return {"done": self._done,
+                "queued": [len(q) for q in self._queues]}
+
+
+class DataIterator:
+    """One consumer's stream (reference: ray.data.DataIterator). Supports
+    the ingest surface JaxTrainer uses: iter_batches / iter_rows / a single
+    pass. A second iteration re-pulls from the SHARED stream — like the
+    reference, streaming_split iterators are single-epoch unless the caller
+    re-splits."""
+
+    def __init__(self, coordinator, split_idx: int):
+        self._coord = coordinator
+        self._split_idx = split_idx
+
+    def iter_blocks(self) -> Iterator[pa.Table]:
+        import ray_tpu
+        while True:
+            blk = ray_tpu.get(self._coord.next_block.remote(self._split_idx),
+                              timeout=600)
+            if blk is None:
+                return
+            if blk.num_rows:
+                yield blk
+
+    def iter_rows(self) -> Iterator[dict]:
+        from . import block as B
+        for blk in self.iter_blocks():
+            yield from B.block_to_rows(blk)
+
+    def iter_batches(self, *, batch_size: int = 256,
+                     batch_format: str = "numpy") -> Iterator:
+        from . import block as B
+        carry: List[pa.Table] = []
+        carry_rows = 0
+        for blk in self.iter_blocks():
+            carry.append(blk)
+            carry_rows += blk.num_rows
+            while carry_rows >= batch_size:
+                whole = B.block_concat(carry)
+                batch = whole.slice(0, batch_size)
+                rest = whole.slice(batch_size)
+                carry = [rest] if rest.num_rows else []
+                carry_rows = rest.num_rows
+                yield B.block_to_format(batch, batch_format)
+        if carry_rows:
+            yield B.block_to_format(B.block_concat(carry), batch_format)
+
+    def materialize(self) -> List[pa.Table]:
+        return list(self.iter_blocks())
+
+    def __reduce__(self):
+        return (DataIterator, (self._coord, self._split_idx))
+
+
+def streaming_split(dataset, n: int, *, equal: bool = False,
+                    locality_hints=None) -> List[DataIterator]:
+    """See Dataset.streaming_split."""
+    import cloudpickle
+
+    import ray_tpu
+    del locality_hints  # single-host placement; accepted for API parity
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    Coord = ray_tpu.remote(num_cpus=0, max_concurrency=max(n, 2))(
+        _SplitCoordinator)
+    coord = Coord.remote(cloudpickle.dumps(dataset._plan), n, equal)
+    return [DataIterator(coord, i) for i in range(n)]

@@ -55,11 +55,8 @@ class _ModelCache:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._loading[model_id] = fut
-        try:
-            # evict BEFORE loading: if max_models models fill the device,
-            # holding N+1 during the load would OOM exactly when the cap is
-            # sized to the hardware
-            while len(self.models) >= self.max_models:
+        async def _evict_to(limit: int):
+            while len(self.models) >= limit:
                 _old_id, old = self.models.popitem(last=False)
                 unload = getattr(old, "unload", None)
                 if callable(unload):
@@ -67,9 +64,18 @@ class _ModelCache:
                     if asyncio.iscoroutine(maybe):
                         await maybe
                 del old
+
+        try:
+            # evict BEFORE loading: if max_models models fill the device,
+            # holding N+1 during the load would OOM exactly when the cap is
+            # sized to the hardware
+            await _evict_to(self.max_models)
             out = self.loader(owner, model_id)
             if asyncio.iscoroutine(out):
                 out = await out
+            # concurrent loads of DISTINCT models can each pass the first
+            # eviction check; re-enforce the cap before inserting
+            await _evict_to(self.max_models)
             self.models[model_id] = out
             fut.set_result(out)
             return out

@@ -252,16 +252,20 @@ class Tuner:
             if not force and now - _last_save[0] < 1.0:
                 return
             _last_save[0] = now
+            import cloudpickle
             recs = []
             for t in trials:
                 recs.append({
                     "trial_id": t.trial_id, "config": t.config,
+                    # configs must round-trip EXACTLY (numpy scalars, tuples
+                    # — default=str would silently corrupt a restored run)
+                    "config_pkl": cloudpickle.dumps(t.config).hex(),
                     "state": t.state, "results": t.results,
                     "last_ckpt_dir": t.last_ckpt_dir, "error": t.error,
                     "resume_from": t.resume_from,
                 })
             blob = json.dumps({"counter": counter[0], "trials": recs,
-                               "exhausted": exhausted}, default=str)
+                               "exhausted": exhausted}, default=repr)
             tmp = os.path.join(exp_dir, "tuner.json.tmp")
             os.makedirs(exp_dir, exist_ok=True)
             with open(tmp, "w") as f:
@@ -277,7 +281,11 @@ class Tuner:
             # suggestion originally, so they are not replayed through the
             # searcher. Unfinished trials relaunch from their last
             # checkpoint — via the MAIN loop, under max_concurrent_trials.
+            import cloudpickle as _cp
             for rec in self._restore_state["trials"]:
+                if rec.get("config_pkl"):  # exact round-trip (numpy/tuples)
+                    rec = {**rec,
+                           "config": _cp.loads(bytes.fromhex(rec["config_pkl"]))}
                 if not rec["trial_id"].endswith("_pbt"):
                     searcher.suggest(rec["trial_id"])  # advance the stream
                 if rec["state"] in ("TERMINATED", "ERROR"):

@@ -1,0 +1,106 @@
+"""Binary readers + streaming_split (VERDICT r3 missing #4; ref:
+read_api.py:1147 read_images, :1974 read_tfrecords, dataset.py:2043
+streaming_split)."""
+
+import os
+import tarfile
+
+import numpy as np
+import pytest
+
+
+def test_read_images_roundtrip(tmp_path, ray_session):
+    from PIL import Image
+    import ray_tpu.data as rdata
+
+    rng = np.random.default_rng(0)
+    for i in range(4):
+        arr = rng.integers(0, 255, (13 + i, 17, 3), np.uint8)
+        Image.fromarray(arr).save(tmp_path / f"im_{i}.png")
+
+    ds = rdata.read_images(str(tmp_path), size=(16, 12), include_paths=True)
+    rows = ds.take_all()
+    assert len(rows) == 4
+    for r in rows:
+        assert r["image"].shape == (12, 16, 3)
+        assert r["image"].dtype == np.uint8
+        assert os.path.basename(r["path"]).startswith("im_")
+
+    # uniform originals round-trip exactly (no resize)
+    exact = np.arange(4 * 5 * 3, dtype=np.uint8).reshape(4, 5, 3)
+    Image.fromarray(exact).save(tmp_path / "exact.png")
+    got = rdata.read_images(str(tmp_path / "exact.png")).take_all()[0]["image"]
+    np.testing.assert_array_equal(got, exact)
+
+
+def test_read_tfrecords_roundtrip(tmp_path, ray_session):
+    import ray_tpu.data as rdata
+
+    rows = [
+        {"name": b"alpha", "score": 1.5, "ids": [1, 2, 3]},
+        {"name": b"beta", "score": -2.25, "ids": [40]},
+        {"name": b"gamma", "score": 0.0, "ids": [-7, 1 << 40]},
+    ]
+    path = str(tmp_path / "data.tfrecord")
+    rdata.write_tfrecords(rows, path)
+
+    got = rdata.read_tfrecords(path).take_all()
+    assert len(got) == 3
+    for want, have in zip(rows, got):
+        assert have["name"] == want["name"]
+        assert abs(have["score"] - want["score"]) < 1e-6
+        # mixed arities stay lists for the whole column
+        assert list(have["ids"]) == list(want["ids"]), (have["ids"], want)
+
+
+def test_read_webdataset(tmp_path, ray_session):
+    import ray_tpu.data as rdata
+
+    shard = tmp_path / "shard-000.tar"
+    with tarfile.open(shard, "w") as tar:
+        for i in range(3):
+            for ext, payload in (("jpg", b"IMG%d" % i), ("cls", b"%d" % i)):
+                p = tmp_path / f"sample{i}.{ext}"
+                p.write_bytes(payload)
+                tar.add(p, arcname=f"sample{i}.{ext}")
+
+    rows = rdata.read_webdataset(str(shard)).take_all()
+    assert [r["__key__"] for r in rows] == ["sample0", "sample1", "sample2"]
+    assert rows[1]["jpg"] == b"IMG1" and rows[1]["cls"] == b"1"
+
+
+def test_streaming_split_disjoint_and_complete(ray_session):
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(4000, override_num_blocks=16).map_batches(
+        lambda b: {"id": b["id"], "sq": b["id"] ** 2})
+    its = ds.streaming_split(2)
+    a = [r["id"] for r in its[0].iter_rows()]
+    b = [r["id"] for r in its[1].iter_rows()]
+    assert not (set(a) & set(b))               # disjoint
+    assert sorted(a + b) == list(range(4000))  # complete
+
+
+def test_streaming_split_two_train_workers_disjoint(ray_session):
+    """The dp-ingest pattern: each train worker consumes its own iterator
+    from ONE shared stream and sees a disjoint half of the data."""
+    ray = ray_session
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(2000, override_num_blocks=8)
+    its = ds.streaming_split(2, equal=True)
+
+    @ray.remote
+    def train_worker(it, rank):
+        seen = []
+        for batch in it.iter_batches(batch_size=128):
+            seen.extend(int(x) for x in batch["id"])
+        return rank, seen
+
+    out = ray.get([train_worker.remote(its[i], i) for i in range(2)],
+                  timeout=180)
+    seen = {rank: ids for rank, ids in out}
+    assert not (set(seen[0]) & set(seen[1]))
+    assert sorted(seen[0] + seen[1]) == list(range(2000))
+    # equal=True: block-granular balance (8 blocks -> 4/4)
+    assert len(seen[0]) == len(seen[1]) == 1000
