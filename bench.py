@@ -114,12 +114,21 @@ def _mapped_shm_segments():
 
 
 def _any_live_session() -> bool:
-    """Any controller socket (tempdir rtpu-*.sock) still accepting?"""
+    """Any controller socket still accepting? Sockets live under the
+    per-user scratch root (r4: _private/paths.py) — the old flat-tempdir
+    location is checked too for sessions from older builds."""
     import glob as _glob
     import tempfile
-    for sock in _glob.glob(os.path.join(tempfile.gettempdir(), "rtpu-*.sock")):
-        if _controller_alive(sock):
-            return True
+    roots = [tempfile.gettempdir()]
+    try:
+        from ray_tpu._private import paths
+        roots.append(paths.user_tmp_root())
+    except Exception:  # noqa: BLE001 - fall back to flat tempdir only
+        pass
+    for root in roots:
+        for sock in _glob.glob(os.path.join(root, "rtpu-*.sock")):
+            if _controller_alive(sock):
+                return True
     return False
 
 
@@ -214,6 +223,37 @@ def _run_child(config, cpu_scrub=False):
     return None
 
 
+def _run_aux_bench(script, timeout, env_extra=None):
+    """Run a secondary benchmark child; returns its JSON dict or an error
+    record. Never fails the round — the train headline must survive."""
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    cmd = [sys.executable, os.path.join(REPO, "benchmarks", script)]
+    _log(f"bench: aux {script} timeout={timeout}s")
+    try:
+        r = subprocess.run(cmd, env=env, cwd=REPO, timeout=timeout,
+                           capture_output=True, text=True)
+    except subprocess.TimeoutExpired:
+        return {"error": f"timeout after {timeout}s"}
+    sys.stderr.write(r.stderr[-2000:])
+    if r.returncode != 0:
+        return {"error": f"rc={r.returncode}: {r.stdout[-300:]}"}
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            candidate = json.loads(line)
+            if isinstance(candidate, dict):
+                return candidate
+        except json.JSONDecodeError:
+            # decode_bench prefixes its record with "JSON: "
+            if line.startswith("JSON:"):
+                try:
+                    return json.loads(line[5:])
+                except json.JSONDecodeError:
+                    continue
+            continue
+    return {"error": "no JSON line"}
+
+
 def orchestrate():
     _kill_stale_workers()
     _sweep_orphan_shm()
@@ -236,6 +276,17 @@ def orchestrate():
         sys.exit(1)
     prior = _prior_value(result["metric"])
     result["vs_baseline"] = round(result["value"] / prior, 3) if prior else 1.0
+    # the other two BASELINE headline metrics ride the same record
+    # (VERDICT r3 weak #4: perf that isn't recorded regresses silently):
+    # serve decode tok/s + TTFT p50/p99 (dense vs paged, B=8 and 32) and
+    # RLlib PPO env-steps/s. Failures record as {"error": ...} — they never
+    # sink the train number.
+    if not os.environ.get("RAY_TPU_BENCH_TRAIN_ONLY"):
+        result["serving_b8"] = _run_aux_bench("serving_bench.py", 900,
+                                              {"B": "8"})
+        result["serving_b32"] = _run_aux_bench("serving_bench.py", 900,
+                                               {"B": "32"})
+        result["rllib_ppo"] = _run_aux_bench("rllib_bench.py", 600)
     print(json.dumps(result))
 
 

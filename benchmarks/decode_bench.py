@@ -10,6 +10,21 @@ Numbers recorded in README.md (v5e, B=8): dense ~1.8k tok/s; paged ~2.0k
 tok/s at page 128 after the batched-heads kernel + in-place DUS writes.
 Sync is via host fetch — on the axon tunnel `block_until_ready` returns
 before execution finishes.
+
+ROOFLINE (the denominator VERDICT r3 weak #3 asked for): decode is
+HBM-bandwidth-bound on reading the weights once per step —
+
+    bytes/step ≈ 2 B/param × 852.6M params (llama_1b bf16)   = 1.71 GB
+               + B·L·2·Kh·D·len·2 B of KV   (B=8, len 64:     17 MB)
+    v5e HBM ≈ 819 GB/s → step floor ≈ 2.1 ms
+    → tok/s ceiling ≈ B / 2.1 ms: B=8 → ~3.8k, B=32 → ~15k, B=64 → ~30k
+
+Measured dense B=8 (4.5 ms/step, 1.78k tok/s) is ~46% of roofline; the gap
+is per-step dispatch latency on the tunnel + unfused sampling/bookkeeping
+ops, not attention (KV bytes are 1% of weight bytes at these lengths).
+Throughput scales ~linearly in B until KV reads rival weight reads
+(B·len ≈ 26k tokens at this config), which is why continuous batching at
+B=32–64 is the whole game for serving efficiency.
 """
 
 import functools
@@ -18,6 +33,17 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+# env-var platform switching (JAX_PLATFORMS=cpu) races this image's
+# sitecustomize-initialized remote-compile hook and can hang the first
+# compile; flipping via jax.config after import is reliable (conftest.py
+# pattern — see axon notes).
+import os as _os
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    _os.environ.pop("JAX_PLATFORMS")
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,60 @@
+"""RLlib PPO throughput: env-steps/sec (BASELINE.json headline #2).
+
+Single JSON line: {"ppo_env_steps_per_sec": N, ...}. Runs PPO on CartPole
+for a fixed wall budget after one warmup iteration (compile excluded).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+
+# env-var platform switching (JAX_PLATFORMS=cpu) races this image's
+# sitecustomize-initialized remote-compile hook and can hang the first
+# compile; flipping via jax.config after import is reliable (conftest.py
+# pattern — see axon notes).
+import os as _os
+if _os.environ.get("JAX_PLATFORMS") == "cpu":
+    _os.environ.pop("JAX_PLATFORMS")
+    import jax as _jax
+    _jax.config.update("jax_platforms", "cpu")
+
+def main():
+    import jax
+
+    from ray_tpu.rllib import PPOConfig
+
+    config = (
+        PPOConfig()
+        .environment("CartPole-v1")
+        .env_runners(num_env_runners=0, num_envs_per_env_runner=8,
+                     rollout_fragment_length=64)
+        .training(lr=3e-4, train_batch_size=256, minibatch_size=128,
+                  num_epochs=2)
+        .debugging(seed=0)
+    )
+    algo = config.build()
+    algo.train()  # warmup: compiles the learner step
+
+    iters = 0
+    steps = 0
+    t0 = time.perf_counter()
+    while time.perf_counter() - t0 < float(os.environ.get("BUDGET_S", 15)):
+        result = algo.train()
+        iters += 1
+        steps += int(result.get("num_env_steps_sampled_this_iter") or 256)
+    dt = time.perf_counter() - t0
+    algo.stop()
+    print(json.dumps({
+        "ppo_env_steps_per_sec": round(steps / dt, 1),
+        "iters": iters, "env_steps": steps,
+        "backend": jax.default_backend(),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -45,7 +45,7 @@ __all__ = [
 ]
 
 _LAZY_SUBMODULES = ("parallel", "models", "ops", "train", "tune", "data",
-                    "serve", "rllib", "util")
+                    "serve", "rllib", "util", "dag", "workflow")
 
 
 def __getattr__(name):

@@ -40,11 +40,24 @@ class BaseClient:
         """Serialize once; returns (meta_len, size, inline_or_None, contained
         ref ids). Writes shm only when over the inline threshold."""
         meta, buffers, contained = serialization.dumps_oob(value)
+        return self._store_parts(oid, meta, buffers, contained)
+
+    def _store_parts(self, oid, meta, buffers, contained):
         size = serialization.total_size(meta, buffers)
         if size <= _INLINE_MAX:
             return 0, size, serialization.pack_parts(meta, buffers), contained
         self.store.put_parts(oid, meta, buffers)
         return len(meta), size, None, contained
+
+    def put_serialized(self, meta, buffers, contained):
+        """put() for an ALREADY-serialized value (encode_arg's implicit put
+        of large args: the bytes were produced sizing the arg — don't
+        serialize twice). Returns the new object id."""
+        oid = ids.object_id()
+        meta_len, size, inline, contained = self._store_parts(
+            oid, meta, buffers, contained)
+        self._register_put(oid, meta_len, size, inline, contained)
+        return oid
 
     def close(self):
         self.store.close()
@@ -94,9 +107,12 @@ class DriverClient(BaseClient):
     def put(self, value):
         oid = ids.object_id()
         meta_len, size, inline, contained = self._encode_to_store(oid, value)
+        self._register_put(oid, meta_len, size, inline, contained)
+        return oid
+
+    def _register_put(self, oid, meta_len, size, inline, contained):
         self._call_soon(self.controller.register_put, oid, meta_len, size,
                         inline, contained)
-        return oid
 
     def wait(self, oids, num_returns, timeout):
         return self._call(self.controller.wait(oids, num_returns, timeout))
@@ -335,9 +351,12 @@ class WorkerClient(BaseClient):
     def put(self, value):
         oid = ids.object_id()
         meta_len, size, inline, contained = self._encode_to_store(oid, value)
+        self._register_put(oid, meta_len, size, inline, contained)
+        return oid
+
+    def _register_put(self, oid, meta_len, size, inline, contained):
         self._rpc("put", oid=oid, meta_len=meta_len, size=size, inline=inline,
                   contained=contained)
-        return oid
 
     def put_result(self, oid, value):
         """Store a task result; returns (oid, meta_len, size, inline, contained)."""

@@ -44,6 +44,12 @@ class ActorMethod:
     def remote(self, *args, **kwargs):
         return self._handle._invoke(self._name, args, kwargs, self._num_returns)
 
+    def bind(self, *args, **kwargs):
+        """DAG-build spelling (reference: actor.method.bind in ray.dag):
+        returns a ClassMethodNode for ray_tpu.dag graphs."""
+        from .dag import ClassMethodNode
+        return ClassMethodNode(self._handle, self._name, args, kwargs)
+
     def __call__(self, *args, **kwargs):
         raise TypeError(f"Actor method '{self._name}' must be called with .remote().")
 
@@ -79,7 +85,7 @@ class ActorHandle:
 
     def _invoke(self, method_name, args, kwargs, num_returns):
         client = state.global_client()
-        eargs, ekwargs, nested = encode_call(args, kwargs)
+        eargs, ekwargs, nested, holds = encode_call(args, kwargs)
         spec = TaskSpec(
             task_id=ids.task_id(),
             fn_blob=None,
@@ -190,7 +196,7 @@ class ActorClass:
             runtime_env=dict(opts["runtime_env"]) if opts.get("runtime_env") else None,
             job_id=client.job_id,
         )
-        eargs, ekwargs, nested = encode_call(args, kwargs)
+        eargs, ekwargs, nested, holds = encode_call(args, kwargs)
         creation.args, creation.kwargs = eargs, ekwargs
         creation.nested_refs = nested
         # placement: NodeAffinity/SPREAD ride the spec; PG strategies set the

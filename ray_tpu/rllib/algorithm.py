@@ -171,6 +171,8 @@ class Algorithm:
     def __init__(self, config: AlgorithmConfig):
         self.config = config
         self.iteration = 0
+        self._env_steps_iter = 0
+        self._env_steps_total = 0
         self._timers: Dict[str, float] = {}
         self._runner_handles: List = []
         self._local_runner: Optional[EnvRunner] = None
@@ -217,8 +219,11 @@ class Algorithm:
                 [r.sample.remote(wref) for r in self._runner_handles])
             metrics = ray_tpu.get(
                 [r.pop_metrics.remote() for r in self._runner_handles])
-            return SampleBatch.concat(batches), _merge_runner_metrics(metrics)
+            batch = SampleBatch.concat(batches)
+            self._env_steps_iter += batch.count
+            return batch, _merge_runner_metrics(metrics)
         b = self._local_runner.sample(weights)
+        self._env_steps_iter += b.count
         return b, self._local_runner.pop_metrics()
 
     # -- to implement --------------------------------------------------------
@@ -264,9 +269,17 @@ class Algorithm:
     def train(self) -> Dict[str, Any]:
         import math
         t0 = time.perf_counter()
+        self._env_steps_iter = 0
         result = self.training_step()
         self.iteration += 1
         result.setdefault("training_iteration", self.iteration)
+        # env-step accounting (ref: num_env_steps_sampled_* in result dicts)
+        self._env_steps_total = getattr(self, "_env_steps_total", 0) \
+            + self._env_steps_iter
+        result.setdefault("num_env_steps_sampled_this_iter",
+                          self._env_steps_iter)
+        result.setdefault("num_env_steps_sampled_lifetime",
+                          self._env_steps_total)
         due = self._eval_due()
         # a parallel evaluation launched during an earlier iteration attaches
         # to the first result where it's finished (forced if a new one is due)

@@ -5,7 +5,8 @@ Deployments are async replica actors; handles route with power-of-two-choices;
 adds a continuous-batching LLM replica on a jitted decode step.
 """
 
-from .api import delete, get_deployment_handle, run, shutdown, start, status
+from .api import (delete, get_deployment_handle, grpc_port, run,
+                  shutdown, start, status)
 from .batching import batch
 from .deployment import AutoscalingConfig, Deployment, DeploymentConfig, deployment
 from .handle import DeploymentHandle, DeploymentResponse
@@ -17,6 +18,7 @@ __all__ = [
     "AutoscalingConfig", "Deployment", "DeploymentConfig", "DeploymentHandle",
     "DeploymentResponse", "Request", "Response", "batch", "build_app_config",
     "delete", "deploy_config", "deployment", "get_deployment_handle",
+    "grpc_port",
     "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
     "status",
 ]

@@ -57,9 +57,13 @@ def run(target: BoundDeployment, *, name: str = "default",
 
 
 def start(detached: bool = True, http_options: Optional[Dict] = None,
-          **_compat):
+          grpc_options: Optional[Dict] = None, **_compat):
     """Start the HTTP proxy (reference: serve.start). Returns the bound port
-    — pass port=0 in http_options to grab an ephemeral one (test-friendly)."""
+    — pass port=0 in http_options to grab an ephemeral one (test-friendly).
+
+    `grpc_options={"port": N}` additionally starts the gRPC ingress
+    (serve/grpc_ingress.py); its bound port is returned by
+    `serve.grpc_port()`."""
     from .proxy import start_proxy
     import ray_tpu
     if not ray_tpu.is_initialized():
@@ -67,7 +71,40 @@ def start(detached: bool = True, http_options: Optional[Dict] = None,
     opts = dict(http_options or {})
     _proxy, port = start_proxy(opts.get("host", "127.0.0.1"),
                                opts.get("port", 8000))
+    if grpc_options is not None:
+        _start_grpc(grpc_options.get("port", 9000))
     return port
+
+
+_GRPC_ACTOR_NAME = "_rtpu_serve_grpc"
+
+
+def _start_grpc(port: int) -> int:
+    import ray_tpu
+    from .grpc_ingress import GrpcIngressActor
+    try:
+        actor = ray_tpu.get_actor(_GRPC_ACTOR_NAME, namespace="_system")
+    except ValueError:
+        Actor = ray_tpu.remote(num_cpus=0, max_concurrency=32)(
+            GrpcIngressActor)
+        actor = Actor.options(name=_GRPC_ACTOR_NAME, namespace="_system",
+                              lifetime="detached").remote(port)
+        try:
+            return ray_tpu.get(actor.start.remote(), timeout=60)
+        except Exception:
+            ray_tpu.kill(actor)  # never leave a dead named ingress behind
+            raise
+    return ray_tpu.get(actor.port.remote(), timeout=60)
+
+
+def grpc_port() -> Optional[int]:
+    """The gRPC ingress's bound port, or None when not started."""
+    import ray_tpu
+    try:
+        actor = ray_tpu.get_actor(_GRPC_ACTOR_NAME, namespace="_system")
+    except ValueError:
+        return None
+    return ray_tpu.get(actor.port.remote(), timeout=30)
 
 
 def delete(name: str = "default") -> None:

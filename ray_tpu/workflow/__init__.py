@@ -1,0 +1,192 @@
+"""ray_tpu.workflow — durable task graphs (reference: python/ray/workflow/
+— workflow.run(dag, workflow_id=...), storage-backed step results, resume).
+
+    @ray_tpu.remote
+    def fetch(url): ...
+    @ray_tpu.remote
+    def train(data, lr): ...
+
+    dag = train.bind(fetch.bind("s3://..."), lr=1e-3)
+    out = workflow.run(dag, workflow_id="exp1")
+    # crash anywhere → workflow.resume("exp1") re-runs ONLY unfinished steps
+
+Design (vs the reference's workflow controller actors): a workflow here is
+a static FunctionNode DAG executed step-by-step, each step's result
+pickled into the per-user scratch root before its dependents run. Resume
+replays the journal: completed steps load from storage, everything else
+re-executes. Exactly-once is per-step at-least-once with idempotent
+journaling — the reference's model. Dynamic continuations
+(workflow.continuation) are not implemented; virtual actors are subsumed
+by detached actors + GCS journaling (_private/gcs.py).
+
+Step identity: the DAG's deterministic topological index + function name —
+stable across runs of the same code, no user-supplied step ids needed
+(matching reference behavior for unnamed steps).
+"""
+
+import os
+import pickle
+import time
+from typing import Any, Dict, List, Optional
+
+from ray_tpu._private import paths
+from ray_tpu.dag import FunctionNode
+
+
+def _store_root() -> str:
+    return paths.subdir("workflows")
+
+
+def _wf_dir(workflow_id: str) -> str:
+    if (not workflow_id or os.sep in workflow_id
+            or workflow_id in (".", "..")):
+        # "" would alias the whole store root (delete("") → rm -rf all)
+        raise ValueError(f"workflow_id must be a plain name: {workflow_id!r}")
+    return os.path.join(_store_root(), workflow_id)
+
+
+def _toposort(root: FunctionNode) -> List[FunctionNode]:
+    order: List[FunctionNode] = []
+    seen = set()
+
+    def visit(node):
+        if not isinstance(node, FunctionNode) or id(node) in seen:
+            return
+        seen.add(id(node))
+        for a in list(node.args) + list(node.kwargs.values()):
+            visit(a)
+        order.append(node)
+
+    visit(root)
+    if not order:
+        raise TypeError("workflow.run takes a task DAG built with "
+                        "fn.bind(...)")
+    return order
+
+
+def _step_key(idx: int, node: FunctionNode) -> str:
+    return f"step_{idx:04d}_{node.name}"
+
+
+class _Status:
+    RUNNING = "RUNNING"
+    SUCCESSFUL = "SUCCESSFUL"
+    FAILED = "FAILED"
+
+
+def run(dag: FunctionNode, *, workflow_id: Optional[str] = None) -> Any:
+    """Execute the DAG durably; returns the root node's value. A re-run (or
+    `resume`) with the same workflow_id skips journaled steps."""
+    import uuid
+
+    import ray_tpu
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+    workflow_id = workflow_id or f"wf-{uuid.uuid4().hex[:10]}"
+    wdir = _wf_dir(workflow_id)
+    os.makedirs(wdir, exist_ok=True)
+    _write_meta(wdir, {"status": _Status.RUNNING, "started_at": time.time()})
+
+    order = _toposort(dag)
+    values: Dict[int, Any] = {}
+    try:
+        for idx, node in enumerate(order):
+            key = _step_key(idx, node)
+            path = os.path.join(wdir, key + ".pkl")
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    values[id(node)] = pickle.load(f)
+                continue
+            args = tuple(values[id(a)] if isinstance(a, FunctionNode) else a
+                         for a in node.args)
+            kwargs = {k: values[id(v)] if isinstance(v, FunctionNode) else v
+                      for k, v in node.kwargs.items()}
+            value = ray_tpu.get(node.remote_fn.remote(*args, **kwargs))
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(value, f)
+            os.replace(tmp, path)  # journal BEFORE dependents observe it
+            values[id(node)] = value
+    except BaseException as e:
+        _write_meta(wdir, {"status": _Status.FAILED, "error": repr(e)})
+        raise
+    out = values[id(order[-1])]
+    _write_meta(wdir, {"status": _Status.SUCCESSFUL,
+                       "finished_at": time.time()})
+    return out
+
+
+def run_async(dag: FunctionNode, *, workflow_id: Optional[str] = None):
+    """Reference parity: returns an ObjectRef-like future (a plain task
+    wrapping run — durability semantics identical)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    def _driver(blob):
+        import cloudpickle
+        return run(cloudpickle.loads(blob), workflow_id=workflow_id)
+
+    import cloudpickle
+    return _driver.remote(cloudpickle.dumps(dag))
+
+
+def resume(workflow_id: str, dag: Optional[FunctionNode] = None) -> Any:
+    """Resume a crashed/failed workflow. The reference re-loads the DAG
+    from storage; we journal step RESULTS (not code), so the caller passes
+    the same DAG (plain code re-import) — completed steps are skipped.
+    Without a DAG, returns the stored terminal value if the workflow
+    already finished."""
+    wdir = _wf_dir(workflow_id)
+    if not os.path.isdir(wdir):
+        raise ValueError(f"no workflow {workflow_id!r}")
+    if dag is not None:
+        return run(dag, workflow_id=workflow_id)
+    meta = _read_meta(wdir)
+    if meta.get("status") != _Status.SUCCESSFUL:
+        raise ValueError(
+            f"workflow {workflow_id!r} is {meta.get('status')}; pass the DAG "
+            f"to re-execute its unfinished steps")
+    steps = sorted(p for p in os.listdir(wdir) if p.endswith(".pkl"))
+    with open(os.path.join(wdir, steps[-1]), "rb") as f:
+        return pickle.load(f)
+
+
+def get_status(workflow_id: str) -> str:
+    return _read_meta(_wf_dir(workflow_id)).get("status", "UNKNOWN")
+
+
+def list_all() -> List[Dict[str, Any]]:
+    root = _store_root()
+    out = []
+    for wid in sorted(os.listdir(root)):
+        wdir = os.path.join(root, wid)
+        if os.path.isdir(wdir):
+            meta = _read_meta(wdir)
+            out.append({"workflow_id": wid,
+                        "status": meta.get("status", "UNKNOWN")})
+    return out
+
+
+def delete(workflow_id: str) -> None:
+    import shutil
+    shutil.rmtree(_wf_dir(workflow_id), ignore_errors=True)
+
+
+def _write_meta(wdir: str, updates: Dict) -> None:
+    meta = _read_meta(wdir)
+    meta.update(updates)
+    tmp = os.path.join(wdir, "meta.pkl.tmp")
+    with open(tmp, "wb") as f:
+        pickle.dump(meta, f)
+    os.replace(tmp, os.path.join(wdir, "meta.pkl"))
+
+
+def _read_meta(wdir: str) -> Dict:
+    try:
+        with open(os.path.join(wdir, "meta.pkl"), "rb") as f:
+            return pickle.load(f)
+    except (FileNotFoundError, EOFError):
+        return {}
+
+
+__all__ = ["run", "run_async", "resume", "get_status", "list_all", "delete"]

