@@ -194,7 +194,8 @@ class DriverClient(BaseClient):
         return self._call(self.controller.next_stream_item(task_id, index, timeout))
 
     def create_placement_group(self, bundles, strategy, name=""):
-        return self._call_soon(self.controller.create_placement_group, bundles, strategy, name)
+        return self._call(
+            self.controller.create_pg_any(bundles, strategy, name))
 
     def remove_placement_group(self, pg_id):
         self._call_soon(self.controller.remove_placement_group, pg_id)

@@ -276,13 +276,16 @@ class ClusterServer:
                        for n in self.nodes.values() if n.alive))
 
     # ------------------------------------------------------------ forwarding
-    def forward_task(self, rec, node: NodeConn, options=None):
-        """Hand a deps-ready task (or actor creation) to `node`. Claims the
-        head's optimistic mirror immediately (sync, so one _schedule pass
-        cannot double-place), then ships spec+deps asynchronously."""
+    def _forward(self, rec, node: NodeConn, options=None, wire_spec=None):
+        """Common forwarding tail: mirror claim (plain tasks/creations only
+        — methods run in their actor's standing allocation and PG tasks in
+        their bundle's node-side reserve), bookkeeping, async ship. The
+        claim is SYNC so one _schedule pass cannot double-place."""
         spec: TaskSpec = rec.spec
-        for k, v in spec.resources.items():
-            node.available[k] = node.available.get(k, 0) - v
+        is_method = spec.actor_id and not spec.is_actor_creation
+        if not is_method and not spec.placement_group_id:
+            for k, v in spec.resources.items():
+                node.available[k] = node.available.get(k, 0) - v
         rec.state = "RUNNING"
         rec.node_id = node.node_id
         rec.ts_start = time.time()
@@ -292,18 +295,28 @@ class ClusterServer:
             if actor is not None:
                 actor.node_id = node.node_id
                 node.actors.add(spec.actor_id)
-        self.c.loop.create_task(self._ship(rec, node, options))
+                if options is None:
+                    options = actor.options
+        self.c.loop.create_task(self._ship(rec, node, options, wire_spec))
+
+    def forward_task(self, rec, node: NodeConn, options=None):
+        """Hand a deps-ready task (or actor creation) to `node`."""
+        self._forward(rec, node, options)
 
     def forward_method(self, rec, node: NodeConn):
-        """Actor method call → the node hosting the actor. No resource claim
-        (methods run inside the actor's standing allocation, node-side)."""
-        rec.state = "RUNNING"
-        rec.node_id = node.node_id
-        rec.ts_start = time.time()
-        node.inflight[rec.spec.task_id] = rec
-        self.c.loop.create_task(self._ship(rec, node, None))
+        """Actor method call → the node hosting the actor."""
+        self._forward(rec, node)
 
-    async def _ship(self, rec, node: NodeConn, options):
+    def forward_pg_task(self, rec, node: NodeConn, bundle):
+        """A task bound to a REMOTE bundle: ship it with the spec rewritten
+        to the node-local group."""
+        import dataclasses as _dc
+        wire = _dc.replace(rec.spec,
+                           placement_group_id=bundle.remote_pg_id,
+                           placement_group_bundle_index=bundle.remote_index)
+        self._forward(rec, node, wire_spec=wire)
+
+    async def _ship(self, rec, node: NodeConn, options, wire_spec=None):
         spec: TaskSpec = rec.spec
         try:
             deps = await self._collect_deps(spec, node)
@@ -318,7 +331,8 @@ class ClusterServer:
             return
         if not node.alive:
             return  # _on_node_dead already requeued/failed rec
-        protocol.awrite_msg(node.writer, "fwd_task", spec=spec,
+        protocol.awrite_msg(node.writer, "fwd_task",
+                            spec=wire_spec if wire_spec is not None else spec,
                             result_oids=rec.result_oids, deps=deps,
                             options=options)
 
@@ -358,6 +372,8 @@ class ClusterServer:
     def _release_mirror(self, node: NodeConn, spec: TaskSpec):
         if spec.actor_id and not spec.is_actor_creation:
             return  # methods carry no mirror claim
+        if spec.placement_group_id:
+            return  # PG tasks draw from their bundle, not the node pool
         for k, v in spec.resources.items():
             node.available[k] = node.available.get(k, 0) + v
 
@@ -482,6 +498,45 @@ class ClusterServer:
             self._node_reply(node, p["req_id"], refs=oids)
         except Exception as e:  # noqa: BLE001
             self._node_reply(node, p["req_id"], error=e)
+
+    async def create_remote_pg(self, node_id: str, bundles) -> str:
+        """Reserve bundles on a node via a node-local placement group;
+        returns the node's pg id. Debits the optimistic mirror (trued by
+        the next heartbeat). The request carries a head-chosen correlation
+        ref: on timeout the head best-effort cancels BY REF, so a late
+        node-side creation cannot leak its reservation."""
+        from . import ids as _ids
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive:
+            raise ValueError(f"node {node_id} is not alive")
+        ref = _ids.new_id("pgref")
+        try:
+            p = await asyncio.wait_for(
+                self._rpc(node, "create_pg", bundles=bundles, ref=ref),
+                timeout=60)
+        except asyncio.TimeoutError:
+            if node.alive:
+                protocol.awrite_msg(node.writer, "remove_pg_ref", ref=ref)
+            raise ValueError(f"node {node_id} did not reserve the bundle "
+                             f"in time") from None
+        if "error" in p:
+            raise p["error"]
+        for b in bundles:
+            for k, v in b.items():
+                node.available[k] = node.available.get(k, 0) - v
+        return p["pg_id"]
+
+    def restore_mirror_bundle(self, node_id: str, resources):
+        node = self.nodes.get(node_id)
+        if node is not None:
+            for k, v in resources.items():
+                node.available[k] = node.available.get(k, 0) + v
+
+    def remove_remote_pg(self, node_id: str, remote_pg_id: str):
+        node = self.nodes.get(node_id)
+        if node is not None and node.alive:
+            protocol.awrite_msg(node.writer, "remove_pg",
+                                pg_id=remote_pg_id)
 
     def free_object(self, oid: str, node_id: str):
         node = self.nodes.get(node_id)

@@ -307,6 +307,11 @@ class ActorRecord:
 class Bundle:
     resources: Dict[str, float]
     available: Dict[str, float]
+    # cross-node bundles (cluster mode): the hosting node's id plus the
+    # node-local placement group that actually reserves the resources
+    node_id: Optional[str] = None
+    remote_pg_id: Optional[str] = None
+    remote_index: int = 0
 
 
 @dataclass
@@ -584,11 +589,7 @@ class Controller:
         elif kind == "timeline":
             self._reply(w, p["req_id"], events=list(self.timeline_events))
         elif kind == "create_pg":
-            try:
-                self._reply(w, p["req_id"], pg_id=self.create_placement_group(
-                    p["bundles"], p["strategy"], p.get("name", "")))
-            except ValueError as e:
-                self._reply(w, p["req_id"], error=e)
+            self.loop.create_task(self._worker_create_pg(w, p))
         elif kind == "remove_pg":
             self.remove_placement_group(p["pg_id"])
             self._reply(w, p["req_id"], ok=True)
@@ -649,6 +650,14 @@ class Controller:
             ready, not_ready = await self.wait(p["oids"], p["num_returns"], p.get("timeout"))
             self._reply(w, p["req_id"], ready=ready, not_ready=not_ready)
         except Exception as e:  # noqa: BLE001 - ship the error to the caller
+            self._reply(w, p["req_id"], error=e)
+
+    async def _worker_create_pg(self, w, p):
+        try:
+            pg_id = await self.create_pg_any(p["bundles"], p["strategy"],
+                                             p.get("name", ""))
+            self._reply(w, p["req_id"], pg_id=pg_id)
+        except Exception as e:  # noqa: BLE001 - ship to the caller
             self._reply(w, p["req_id"], error=e)
 
     async def _worker_next_stream(self, w, p):
@@ -788,6 +797,28 @@ class Controller:
                 return
             actor.queue.append(rec)
         else:
+            if (self.cluster is not None
+                    and rec.spec.placement_group_id):
+                pg = self.pgroups.get(rec.spec.placement_group_id)
+                if pg is not None:
+                    idx = rec.spec.placement_group_bundle_index
+                    bundle = pg.bundles[idx if idx >= 0 else 0]
+                    if bundle.node_id is not None:
+                        # the bundle lives on a worker node: the task follows
+                        node = self.cluster.nodes.get(bundle.node_id)
+                        if node is None or not node.alive:
+                            self._fail_pg_task(
+                                rec, rec.spec.placement_group_id,
+                                reason=f"bundle {idx}'s host node "
+                                       f"{bundle.node_id} is not alive")
+                            return
+                        if rec.spec.num_returns == "streaming":
+                            self._fail_task(rec, ValueError(
+                                "streaming tasks bound to a remote-node "
+                                "bundle are not supported yet"))
+                            return
+                        self.cluster.forward_pg_task(rec, node, bundle)
+                        return
             if (self.cluster is not None and self.cluster.nodes
                     and not rec.spec.placement_group_id
                     and rec.spec.num_returns != "streaming"):
@@ -2159,6 +2190,9 @@ class Controller:
     # --------------------------------------------------------- placement groups
     def create_placement_group(self, bundles: List[Dict[str, float]], strategy: str,
                                name: str = "") -> str:
+        """Single-host reservation (every bundle on the head). Cluster mode
+        goes through create_pg_any, which distributes bundles across nodes
+        per strategy (ref: gcs_placement_group_scheduler.cc)."""
         pg_id = ids.group_id()
         for b in bundles:
             if not self._resources_fit(b, self.available):
@@ -2174,12 +2208,122 @@ class Controller:
                                                    strategy=strategy, name=name)
         return pg_id
 
-    def _fail_pg_task(self, rec: TaskRecord, pg_id: str):
+    def _plan_pg_hosts(self, bundles: List[Dict[str, float]],
+                       strategy: str) -> List[Optional[str]]:
+        """Per-bundle host assignment (None = head). Cumulative fit is
+        tracked so co-located bundles must fit TOGETHER."""
+        import collections as _c
+        hosts: List[Optional[str]] = [None] + [
+            nid for nid, n in self.cluster.nodes.items() if n.alive]
+
+        def pool(h):
+            return (self.available if h is None
+                    else self.cluster.nodes[h].available)
+
+        committed: Dict[Optional[str], Dict[str, float]] = {
+            h: _c.defaultdict(float) for h in hosts}
+
+        def fits(b, h):
+            p = pool(h)
+            return all(p.get(k, 0) - committed[h][k] + 1e-9 >= v
+                       for k, v in b.items())
+
+        def take(b, h):
+            for k, v in b.items():
+                committed[h][k] += v
+
+        if strategy in ("PACK", "STRICT_PACK"):
+            for h in hosts:  # one host for everything; head preferred
+                ok = True
+                for b in bundles:
+                    if fits(b, h):
+                        take(b, h)
+                    else:
+                        ok = False
+                        break
+                if ok:
+                    return [h] * len(bundles)
+                committed[h] = _c.defaultdict(float)
+            if strategy == "STRICT_PACK":
+                raise ValueError(
+                    "STRICT_PACK: no single node fits every bundle")
+            # PACK falls through to best-effort dispersal
+        assign: List[Optional[str]] = []
+        used: set = set()
+        for b in bundles:
+            if strategy == "STRICT_SPREAD":
+                cands = [h for h in hosts if h not in used]
+            elif strategy == "PACK":
+                # overflow dispersal keeps PACK's locality bias: fill hosts
+                # already in use before opening a new one
+                cands = ([h for h in hosts if h in used]
+                         + [h for h in hosts if h not in used])
+            else:  # SPREAD: prefer unused hosts, allow reuse
+                cands = ([h for h in hosts if h not in used]
+                         + [h for h in hosts if h in used])
+            # the head's id IS None — a None default would shadow it
+            h = _MISSING = object()
+            for cand in cands:
+                if fits(b, cand):
+                    h = cand
+                    break
+            if h is _MISSING:
+                raise ValueError(
+                    f"Cannot reserve bundle {b} under {strategy}: no "
+                    f"{'distinct ' if strategy == 'STRICT_SPREAD' else ''}"
+                    f"node fits it")
+            take(b, h)
+            used.add(h)
+            assign.append(h)
+        return assign
+
+    async def create_pg_any(self, bundles: List[Dict[str, float]],
+                            strategy: str, name: str = "") -> str:
+        """Cluster-aware placement group creation: bundles land on the head
+        AND worker nodes per strategy; remote bundles reserve through a
+        node-local single-bundle-group (ref: the GCS placement group
+        scheduler's 2-phase reserve)."""
+        if self.cluster is None or not self.cluster.nodes:
+            return self.create_placement_group(bundles, strategy, name)
+        assign = self._plan_pg_hosts(bundles, strategy)
+        pg_id = ids.group_id()
+        bs: List[Bundle] = []
+        created_remote: List[tuple] = []  # (node_id, remote_pg_id, resources)
+        try:
+            for b, host in zip(bundles, assign):
+                if host is None:
+                    if not self._resources_fit(b, self.available):
+                        raise ValueError(f"Cannot reserve bundle {b} on head")
+                    self._claim(b, self.available)
+                    bundle = Bundle(resources=dict(b), available=dict(b))
+                    self.ready_queue.register_pool(bundle.available)
+                else:
+                    remote_id = await self.cluster.create_remote_pg(host, [b])
+                    created_remote.append((host, remote_id, dict(b)))
+                    bundle = Bundle(resources=dict(b), available=dict(b),
+                                    node_id=host, remote_pg_id=remote_id,
+                                    remote_index=0)
+                bs.append(bundle)
+        except BaseException:
+            for bundle in bs:  # rollback partial reservations
+                if bundle.node_id is None:
+                    self.ready_queue.drop_pool(bundle.available)
+                    self._release(bundle.resources, self.available)
+            for host, rid, res in created_remote:
+                self.cluster.remove_remote_pg(host, rid)
+                self.cluster.restore_mirror_bundle(host, res)
+            raise
+        self.pgroups[pg_id] = PlacementGroupRecord(pg_id=pg_id, bundles=bs,
+                                                   strategy=strategy,
+                                                   name=name)
+        return pg_id
+
+    def _fail_pg_task(self, rec: TaskRecord, pg_id: str,
+                      reason: str = "removed before this work could run"):
         """Fail work whose placement group is gone; actor creations go
         through _fail_actor so the actor record dies too (method calls fail
         instead of queueing forever — same as the infeasible-creation path)."""
-        err = ValueError(f"placement group {pg_id} removed before this "
-                         f"work could run")
+        err = ValueError(f"placement group {pg_id} {reason}")
         if rec.spec.is_actor_creation:
             actor = self.actors.get(rec.spec.actor_id)
             if actor is not None:
@@ -2199,6 +2343,15 @@ class Controller:
                 self._fail_pg_task(rec, pg_id)
         self.ready_queue.retire_pg_sigs(pg_id)
         for b in pg.bundles:
+            if b.node_id is not None:
+                # remote bundle: the hosting node releases its own reserve
+                if self.cluster is not None:
+                    self.cluster.remove_remote_pg(b.node_id, b.remote_pg_id)
+                    node = self.cluster.nodes.get(b.node_id)
+                    if node is not None:  # restore the optimistic mirror
+                        for k, v in b.resources.items():
+                            node.available[k] = node.available.get(k, 0) + v
+                continue
             self.ready_queue.drop_pool(b.available)
             # Return only what no running task holds; each still-running PG
             # task settles its own claim into the cluster pool when it

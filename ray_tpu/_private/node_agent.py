@@ -192,6 +192,7 @@ class NodeAgent:
         self._reqs: Dict[int, asyncio.Future] = {}
         self._req_counter = 0
         self._watchers = 0
+        self._head_pg_refs: Dict[str, str] = {}  # head ref -> local pg id
 
     # ------------------------------------------------------------ lifecycle
     async def run(self):
@@ -254,6 +255,27 @@ class NodeAgent:
                             meta_len=meta.meta_len)
         elif kind == "free_object":
             c.decref([p["oid"]])
+        elif kind == "create_pg":
+            # a cross-node placement group's bundle(s) hosted here: reserve
+            # via a node-local group (ref: GCS 2-phase bundle reserve). The
+            # head's correlation ref lets a timed-out head cancel this exact
+            # reservation even though it never learned the pg id.
+            try:
+                pg_id = c.create_placement_group(p["bundles"], "PACK")
+                if p.get("ref"):
+                    self._head_pg_refs[p["ref"]] = pg_id
+                self._reply(p["req_id"], pg_id=pg_id)
+            except Exception as e:  # noqa: BLE001
+                self._reply(p["req_id"], error=e)
+        elif kind == "remove_pg":
+            c.remove_placement_group(p["pg_id"])
+            self._head_pg_refs = {r: pid for r, pid in
+                                  self._head_pg_refs.items()
+                                  if pid != p["pg_id"]}
+        elif kind == "remove_pg_ref":
+            pg_id = self._head_pg_refs.pop(p["ref"], None)
+            if pg_id is not None:
+                c.remove_placement_group(pg_id)
         elif kind == "cancel":
             c.cancel(p["task_id"], force=p.get("force", False))
         elif kind == "kill_actor":

@@ -1,22 +1,24 @@
 """Placement groups (reference: python/ray/util/placement_group.py).
 
-Bundles reserve resources on the HEAD node; tasks/actors bound to a bundle
-run there (cluster placement skips PG work — _private/controller.py
-_enqueue_ready). Cross-node bundle placement is future work; scheduling
-strategies (SPREAD/NodeAffinity) are the multi-node path today.
+With a cluster head (init(cluster_port=...)), bundles are placed ACROSS
+HOSTS per strategy (controller.create_pg_any → _plan_pg_hosts; remote
+bundles reserve through node-local groups, the analog of the GCS 2-phase
+bundle reserve) and tasks bound to a bundle run on its host:
 
-Head-node semantics, stated loudly (VERDICT r2 weak #10):
-- A bundle is a resource reservation carved out of the host pool; tasks
+- PACK / STRICT_PACK: one host for every bundle (head preferred); PACK
+  falls back to dispersal when no single host fits, STRICT_PACK fails.
+- SPREAD: best-effort dispersal — distinct hosts first, reuse allowed.
+- STRICT_SPREAD: each bundle on a DIFFERENT host. With more bundles than
+  hosts the reference leaves the group pending forever; we fail fast with a
+  clear error instead of hanging (same policy as infeasible task resources).
+- A bundle is a resource reservation carved out of its host's pool; tasks
   scheduled into a bundle draw from that bundle's sub-pool, so admission
   accounting matches the reference exactly.
-- PACK / STRICT_PACK: all bundles on one node — trivially satisfied here.
-- SPREAD: best-effort spread across nodes — on one node that best effort is
-  co-location; accepted, like the reference with a 1-node cluster.
-- STRICT_SPREAD: each bundle on a DIFFERENT node. With more bundles than
-  nodes the reference leaves the group pending forever; we fail fast with a
-  clear error instead of hanging (same policy as infeasible task resources).
 - Unknown strategy names are rejected (the reference validates too:
   python/ray/util/placement_group.py validate_placement_group).
+
+Single host: everything lands on the head, like the reference with a
+1-node cluster.
 """
 
 import time
