@@ -210,9 +210,9 @@ class ClusterServer:
         """Pick a node for a deps-ready task. None = run on the head.
 
         Called from _enqueue_ready; placement-group work, streaming
-        generators, and actor methods never reach here (PGs are head-local;
-        streams need the head's stream table; methods follow their actor).
-        """
+        generators, and actor methods never reach here — PG tasks follow
+        their BUNDLE's host via forward_pg_task, streams need the head's
+        stream table, and methods follow their actor."""
         spec: TaskSpec = rec.spec
         strat = spec.scheduling_strategy
         live = [n for n in self.nodes.values() if n.alive]

@@ -826,11 +826,8 @@ class Controller:
                 if rec.state == FAILED:
                     return  # hard NodeAffinity to a dead node
                 if node is not None:
-                    options = None
-                    if rec.spec.is_actor_creation:
-                        a = self.actors.get(rec.spec.actor_id)
-                        options = a.options if a is not None else None
-                    self.cluster.forward_task(rec, node, options)
+                    # actor-creation options resolve inside _forward
+                    self.cluster.forward_task(rec, node)
                     return
             self.ready_queue.append(rec)
 
@@ -2194,10 +2191,15 @@ class Controller:
         goes through create_pg_any, which distributes bundles across nodes
         per strategy (ref: gcs_placement_group_scheduler.cc)."""
         pg_id = ids.group_id()
-        for b in bundles:
-            if not self._resources_fit(b, self.available):
+        committed: Dict[str, float] = {}
+        for b in bundles:  # cumulative: co-located bundles must fit TOGETHER
+            if not all(self.available.get(k, 0) - committed.get(k, 0) + 1e-9
+                       >= v for k, v in b.items()):
                 raise ValueError(f"Cannot reserve bundle {b}: insufficient resources "
-                                 f"(available={self.available})")
+                                 f"(available={self.available}, "
+                                 f"already reserved={committed})")
+            for k, v in b.items():
+                committed[k] = committed.get(k, 0) + v
         bs = []
         for b in bundles:
             self._claim(b, self.available)
@@ -2209,14 +2211,20 @@ class Controller:
         return pg_id
 
     def _plan_pg_hosts(self, bundles: List[Dict[str, float]],
-                       strategy: str) -> List[Optional[str]]:
+                       strategy: str,
+                       use_totals: bool = False) -> List[Optional[str]]:
         """Per-bundle host assignment (None = head). Cumulative fit is
-        tracked so co-located bundles must fit TOGETHER."""
+        tracked so co-located bundles must fit TOGETHER. `use_totals` plans
+        against host TOTALS instead of current availability — the
+        feasibility oracle that separates 'retry later' from 'never'."""
         import collections as _c
         hosts: List[Optional[str]] = [None] + [
             nid for nid, n in self.cluster.nodes.items() if n.alive]
 
         def pool(h):
+            if use_totals:
+                return (self.total if h is None
+                        else self.cluster.nodes[h].resources)
             return (self.available if h is None
                     else self.cluster.nodes[h].available)
 
@@ -2285,28 +2293,53 @@ class Controller:
         scheduler's 2-phase reserve)."""
         if self.cluster is None or not self.cluster.nodes:
             return self.create_placement_group(bundles, strategy, name)
-        assign = self._plan_pg_hosts(bundles, strategy)
+        try:
+            assign = self._plan_pg_hosts(bundles, strategy)
+        except ValueError:
+            # transient shortage, or can-never-fit? Plan against TOTALS to
+            # tell them apart, so callers retry only the retryable
+            # (placement_group()'s poll loop keys on the error type)
+            try:
+                self._plan_pg_hosts(bundles, strategy, use_totals=True)
+            except ValueError as e:
+                raise exc.PlacementGroupInfeasibleError(str(e)) from None
+            raise
         pg_id = ids.group_id()
         bs: List[Bundle] = []
         created_remote: List[tuple] = []  # (node_id, remote_pg_id, resources)
         try:
-            for b, host in zip(bundles, assign):
+            # head claims are sync; remote reservations on DISTINCT nodes go
+            # out concurrently (one slow node overlaps, not serializes)
+            remote_items = []
+            for i, (b, host) in enumerate(zip(bundles, assign)):
                 if host is None:
                     if not self._resources_fit(b, self.available):
                         raise ValueError(f"Cannot reserve bundle {b} on head")
                     self._claim(b, self.available)
                     bundle = Bundle(resources=dict(b), available=dict(b))
                     self.ready_queue.register_pool(bundle.available)
+                    bs.append(bundle)
                 else:
-                    remote_id = await self.cluster.create_remote_pg(host, [b])
-                    created_remote.append((host, remote_id, dict(b)))
-                    bundle = Bundle(resources=dict(b), available=dict(b),
-                                    node_id=host, remote_pg_id=remote_id,
-                                    remote_index=0)
-                bs.append(bundle)
+                    remote_items.append((i, b, host))
+                    bs.append(None)  # filled below
+            results = await asyncio.gather(
+                *(self.cluster.create_remote_pg(host, [b])
+                  for _i, b, host in remote_items),
+                return_exceptions=True)
+            first_err = None
+            for (i, b, host), res in zip(remote_items, results):
+                if isinstance(res, BaseException):
+                    first_err = first_err or res
+                    continue
+                created_remote.append((host, res, dict(b)))
+                bs[i] = Bundle(resources=dict(b), available=dict(b),
+                               node_id=host, remote_pg_id=res,
+                               remote_index=0)
+            if first_err is not None:
+                raise first_err
         except BaseException:
             for bundle in bs:  # rollback partial reservations
-                if bundle.node_id is None:
+                if bundle is not None and bundle.node_id is None:
                     self.ready_queue.drop_pool(bundle.available)
                     self._release(bundle.resources, self.available)
             for host, rid, res in created_remote:

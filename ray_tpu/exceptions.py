@@ -98,6 +98,13 @@ class PendingCallsLimitExceeded(RayTpuError):
     """Actor's pending-call queue limit (max_pending_calls) exceeded."""
 
 
+class PlacementGroupInfeasibleError(RayTpuError, ValueError):
+    """No cluster configuration can EVER host the requested bundles
+    (planned against host totals, not current availability) — retrying
+    cannot help. The reference leaves such groups pending forever; we fail
+    fast."""
+
+
 class _ActorExit(BaseException):
     """Internal: raised by exit_actor(); BaseException so user `except
     Exception` blocks can't swallow it (ref: ray.actor.exit_actor uses

@@ -73,6 +73,8 @@ def placement_group(bundles: List[Dict[str, float]], strategy: str = "PACK",
         try:
             pg_id = client.create_placement_group(bundles, strategy, name)
             return PlacementGroup(pg_id, list(bundles), strategy)
+        except exc.PlacementGroupInfeasibleError:
+            raise  # no retry can help: exceeds host TOTALS
         except ValueError:
             # resources temporarily in use — the reference queues pending PGs;
             # we poll with a deadline

@@ -151,3 +151,27 @@ def test_streaming_repartition_preserves_order(ray_session):
     ids = [i for b in blocks for i in b.column("id").to_pylist()]
     assert ids == list(range(5000))  # row order preserved across re-blocking
     assert [b.num_rows for b in blocks] == [715] * 6 + [710]
+
+
+def test_streaming_split_eager_variants(ray_session):
+    """split/split_at_indices/train_test_split run through the streaming
+    shuffle (no driver concat) and preserve order + exact boundaries."""
+    import ray_tpu.data as rdata
+
+    ds = rdata.range(1000, override_num_blocks=9)
+    a, b, c = ds.split_at_indices([100, 450])
+    assert [r["id"] for r in a.take_all()] == list(range(100))
+    assert [r["id"] for r in b.take_all()] == list(range(100, 450))
+    assert [r["id"] for r in c.take_all()] == list(range(450, 1000))
+
+    parts = ds.split(3)
+    ids = [r["id"] for p in parts for r in p.take_all()]
+    assert ids == list(range(1000))
+
+    eq = ds.split(3, equal=True)
+    sizes = [len(p.take_all()) for p in eq]
+    assert sizes == [334, 334, 332] or sizes == [333, 333, 333], sizes
+
+    tr, te = ds.train_test_split(0.2)
+    assert len(tr.take_all()) == 800 and len(te.take_all()) == 200
+    assert [r["id"] for r in te.take_all()] == list(range(800, 1000))
