@@ -26,6 +26,11 @@ if _os.environ.get("JAX_PLATFORMS") == "cpu":
 def main():
     import jax
 
+    from bench import _INIT_SENTINEL  # repo root is on sys.path (line 12)
+    # bench.py orchestrator init-watchdog sentinel: backend answered
+    print(f"{_INIT_SENTINEL} backend={jax.default_backend()}",
+          file=sys.stderr, flush=True)
+
     from ray_tpu.rllib import PPOConfig
 
     config = (

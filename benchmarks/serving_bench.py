@@ -77,6 +77,10 @@ def bench_mode(paged: bool):
 
 def main():
     import jax
+    from bench import _INIT_SENTINEL  # repo root is on sys.path (line 17)
+    # bench.py orchestrator init-watchdog sentinel: backend answered
+    print(f"{_INIT_SENTINEL} backend={jax.default_backend()}",
+          file=sys.stderr, flush=True)
     out = {"B": B, "max_tokens": MAX_TOKENS, "prompt_len": PROMPT_LEN,
            "backend": jax.default_backend()}
     for name, paged in (("dense", False), ("paged", True)):
