@@ -20,20 +20,33 @@ objects between per-node plasma stores on demand
 - Placement happens once, at the head, when a task's deps are satisfied:
   DEFAULT = local-first with overflow to the least-loaded fitting node;
   SPREAD = round-robin across fitting nodes; NodeAffinity = that node (hard
-  fails if gone, soft falls back). Placement groups stay head-local.
-- Objects move lazily, pull-based, like the reference: a forwarded task
-  ships its dep bytes with the spec (push-on-forward); results stay in the
+  fails if gone, soft falls back). Placement groups SPAN nodes: STRICT_PACK
+  bundles reserve on one host, PACK/SPREAD/STRICT_SPREAD distribute bundles
+  across fitting nodes via create_remote_pg (node-local reservation groups
+  keyed by a head correlation ref).
+- Objects move lazily, pull-based, like the reference: results stay in the
   producing node's store and the head records location "remote:<node_id>",
-  pulling bytes only when something actually `get`s them.
+  pulling bytes only when something actually `get`s them. Node↔node moves
+  are DIRECT (r5): every node runs a token-gated data server
+  (node_agent.ObjectDataServer); the head brokers LOCATION only, handing
+  the consumer a redirect {addr, owner} so dep bytes and fetch misses flow
+  producer→consumer in one hop instead of staging through the head (ref:
+  object_manager.cc Push/Pull between plasma stores). The head stages
+  bytes itself only as a fallback (producer gone/evicted) and counts every
+  staged byte in `staged_bytes` so tests can assert the direct path held.
 - A worker ON a node submits work to its local controller; work the node
   cannot or should not place (infeasible there, SPREAD/NodeAffinity
   strategies, methods on actors living elsewhere) spills UP to the head,
   which places it cluster-wide — the analog of raylet spillback scheduling.
 
 Wire: the same length-prefixed pickle framing as the worker protocol, over
-TCP, with bidirectional request/response multiplexing. An optional shared
-secret (RAY_TPU_CLUSTER_TOKEN) gates node registration; the trust model
-otherwise matches the reference's in-cluster gRPC (flat trusted network).
+TCP, with bidirectional request/response multiplexing. A shared secret
+(RAY_TPU_CLUSTER_TOKEN) gates node registration and the per-node data
+servers; when unset, the head AUTO-GENERATES one at start (exported into
+os.environ so node_main / providers spawned from this process inherit it) —
+an empty token would let any local user speak the pickle wire protocol to
+the loopback port. The trust model otherwise matches the reference's
+in-cluster gRPC (flat trusted network).
 """
 
 import asyncio
@@ -51,6 +64,13 @@ from .task_spec import TaskSpec
 HEARTBEAT_S = 1.0
 
 
+def node_death_timeout_s() -> float:
+    """Head-side silence threshold before a node is declared dead. Generous
+    vs HEARTBEAT_S: a node mid-XLA-compile on a loaded 1-core host can lag
+    heartbeats by seconds without being gone."""
+    return float(os.environ.get("RAY_TPU_NODE_DEATH_S", 15 * HEARTBEAT_S))
+
+
 def cluster_token() -> str:
     return os.environ.get("RAY_TPU_CLUSTER_TOKEN", "")
 
@@ -65,10 +85,14 @@ class NodeConn:
     available: Dict[str, float]      # optimistic mirror, trued by heartbeats
     host: str = ""
     pid: int = 0
+    data_addr: str = ""              # node's ObjectDataServer "host:port"
     inflight: Dict[str, object] = field(default_factory=dict)  # task_id -> rec
     actors: Set[str] = field(default_factory=set)
     alive: bool = True
     last_seen: float = field(default_factory=time.time)
+    ship_seq: int = 0                # per-node fwd_task sequence (see "stats")
+    direct_pull_bytes: int = 0       # node-reported data-plane counters
+    direct_serve_bytes: int = 0
 
 
 class ClusterServer:
@@ -83,6 +107,9 @@ class ClusterServer:
         self._reqs: Dict[int, asyncio.Future] = {}
         self._req_counter = 0
         self._rr = 0  # SPREAD round-robin cursor
+        self._sweeper: Optional[asyncio.Task] = None
+        self.staged_bytes = 0  # bytes the head staged for node↔node moves
+        #                        (fallback path only — should stay ~0)
 
     async def start(self, port: int, host: str = None):
         # loopback by default: binding all interfaces is opt-in
@@ -94,13 +121,44 @@ class ClusterServer:
             raise ValueError(
                 f"refusing to bind cluster port on {host!r} without "
                 f"RAY_TPU_CLUSTER_TOKEN set (pickle wire protocol)")
+        if not cluster_token():
+            # even on loopback an EMPTY token would let any other local user
+            # on a multi-user host speak the pickle wire protocol (= code
+            # execution as this user). Generate one; children (node_main,
+            # node providers, workers) inherit it through the environment.
+            import secrets
+            os.environ["RAY_TPU_CLUSTER_TOKEN"] = secrets.token_hex(16)
         self._server = await asyncio.start_server(self._on_node, host, port)
         self.port = self._server.sockets[0].getsockname()[1]
         self.host = (_socket.gethostname()
                      if host not in ("127.0.0.1", "localhost", "::1")
                      else "127.0.0.1")
+        self._sweeper = self.c.loop.create_task(self._liveness_sweep())
+
+    async def _liveness_sweep(self):
+        """Declare nodes dead on heartbeat SILENCE, not just TCP EOF: a
+        network partition or half-open connection (no FIN/RST) otherwise
+        leaves a vanished node alive=True forever with its inflight tasks
+        hung (ref: gcs_heartbeat_manager.cc num_heartbeats_timeout). Closing
+        the writer tears the socket down, which pops the node out of
+        _on_node's read loop → the single _on_node_dead failover path."""
+        while not self.c._shutdown:
+            await asyncio.sleep(2 * HEARTBEAT_S)
+            now = time.time()
+            for node in list(self.nodes.values()):
+                if node.alive and now - node.last_seen > node_death_timeout_s():
+                    print(f"[cluster] node {node.node_id} heartbeat-silent "
+                          f"{now - node.last_seen:.1f}s; declaring dead",
+                          file=sys.stderr)
+                    node.alive = False
+                    try:
+                        node.writer.close()
+                    except Exception:  # noqa: BLE001
+                        pass
 
     def close(self):
+        if self._sweeper is not None:
+            self._sweeper.cancel()
         if self._server is not None:
             self._server.close()
         for node in self.nodes.values():
@@ -141,7 +199,8 @@ class ClusterServer:
         node = NodeConn(node_id=p["node_id"], writer=writer,
                         resources=dict(p["resources"]),
                         available=dict(p["resources"]),
-                        host=p.get("host", ""), pid=p.get("pid", 0))
+                        host=p.get("host", ""), pid=p.get("pid", 0),
+                        data_addr=p.get("data_addr", ""))
         self.nodes[node.node_id] = node
         protocol.awrite_msg(writer, "register_ok", head_node_id=self.c.node_id)
         self.c._schedule()
@@ -162,7 +221,29 @@ class ClusterServer:
         if kind == "task_result":
             self._on_task_result(node, p)
         elif kind == "stats":
-            node.available = dict(p["available"])
+            # The heartbeat is a BASELINE, not the truth: tasks forwarded
+            # but not yet received by the node (the ship is async; dep
+            # collection can await pulls) are invisible to the node's own
+            # accounting, and a wholesale overwrite would erase the head's
+            # synchronous mirror debits for them → over-forwarding bursts.
+            # Each fwd_task carries a per-node sequence number; the node
+            # echoes the highest it has PROCESSED, and the head re-debits
+            # every inflight claim the echo can't cover yet.
+            base = dict(p["available"])
+            acked = p.get("fwd_seq", 0)
+            for rec in node.inflight.values():
+                spec = rec.spec
+                if spec.actor_id and not spec.is_actor_creation:
+                    continue  # methods carry no mirror claim
+                if spec.placement_group_id:
+                    continue  # PG tasks draw from their bundle
+                seq = getattr(rec, "fwd_seq", None)
+                if seq is None or seq > acked:
+                    for k, v in spec.resources.items():
+                        base[k] = base.get(k, 0) - v
+            node.available = base
+            node.direct_pull_bytes = p.get("direct_pull_bytes", 0)
+            node.direct_serve_bytes = p.get("direct_serve_bytes", 0)
             node.last_seen = time.time()
             c._schedule()
         elif kind == "resp":
@@ -331,15 +412,23 @@ class ClusterServer:
             return
         if not node.alive:
             return  # _on_node_dead already requeued/failed rec
+        # seq assigned at SEND time (not forward time — ships complete out
+        # of order) so the node's stats echo covers exactly the messages it
+        # has seen; see the "stats" handler
+        node.ship_seq += 1
+        rec.fwd_seq = node.ship_seq
         protocol.awrite_msg(node.writer, "fwd_task",
                             spec=wire_spec if wire_spec is not None else spec,
                             result_oids=rec.result_oids, deps=deps,
-                            options=options)
+                            options=options, seq=node.ship_seq)
 
     async def _collect_deps(self, spec: TaskSpec, node: NodeConn):
         """Bytes for every ref the task needs, except those already on the
-        target node. Objects on a THIRD node route through the head (2-hop;
-        the reference does node↔node direct — acceptable at this fan-in)."""
+        target node. Objects on a THIRD node are handed over as a REDIRECT
+        to the owner's data server — the consuming node pulls the bytes
+        producer→consumer in one hop (ref: object_manager.cc Pull); the
+        head stages bytes itself only when the owner has no data server
+        (older node build) and counts them in staged_bytes."""
         deps = []
         oids = [v for kind, v in
                 list(spec.args) + list(spec.kwargs.values()) if kind == "ref"]
@@ -353,10 +442,21 @@ class ClusterServer:
             if loc == f"remote:{node.node_id}":
                 continue  # already local to the target
             if loc.startswith("remote:"):
-                await self.c._pull_remote(oid)  # stage through the head
+                owner = self.nodes.get(loc.split(":", 1)[1])
+                if (owner is not None and owner.alive and owner.data_addr
+                        and owner is not node):
+                    deps.append({"oid": oid, "enc": "redirect",
+                                 "addr": owner.data_addr,
+                                 "owner": owner.node_id, "size": meta.size,
+                                 "meta_len": meta.meta_len,
+                                 "contained": list(meta.contained)})
+                    continue
+                await self.c._pull_remote(oid)  # fallback: stage via head
                 meta = self.c.objects.get(oid)
                 if meta is None:
                     continue
+                if meta.location in ("shm", "spilled"):
+                    self.staged_bytes += meta.size
             if meta.location == "inline":
                 deps.append({"oid": oid, "enc": "inline",
                              "data": meta.inline_value, "size": meta.size,
@@ -397,6 +497,7 @@ class ClusterServer:
             if retryable:
                 rec.retries_left -= 1
                 rec.node_id = None  # re-placed from scratch
+                rec.fwd_seq = None
                 c._enqueue_ready(rec)
                 c._schedule()
                 return
@@ -465,8 +566,24 @@ class ClusterServer:
         return False
 
     async def _serve_fetch(self, node: NodeConn, p: dict):
-        """A node asks the head for an object (uplink miss path)."""
+        """A node asks the head for an object (uplink miss path). If the
+        bytes live on ANOTHER node with a data server, answer with a
+        redirect so the puller goes producer→consumer direct; the head
+        serves bytes itself only for head-local objects or on explicit
+        no_redirect retry (the direct pull failed: owner died/evicted)."""
         oid = p["oid"]
+        meta = self.c.objects.get(oid)
+        if (meta is not None and meta.location.startswith("remote:")
+                and not p.get("no_redirect")):
+            owner = self.nodes.get(meta.location.split(":", 1)[1])
+            if (owner is not None and owner.alive and owner.data_addr
+                    and owner is not node):
+                self._node_reply(node, p["req_id"], found=True,
+                                 enc="redirect", addr=owner.data_addr,
+                                 owner=owner.node_id, size=meta.size,
+                                 meta_len=meta.meta_len,
+                                 contained=list(meta.contained))
+                return
         try:
             descs = await self.c.get_descriptors([oid], p.get("timeout", 120))
             kind, payload = descs[0]
@@ -479,8 +596,12 @@ class ClusterServer:
                                  contained=list(meta.contained))
             else:  # shm at head (a remote location was pulled in by
                    # get_descriptors before the descriptor was returned)
+                was_remote = (meta is not None
+                              and meta.location.startswith("remote:"))
                 meta = self.c.objects[oid]
                 blob = self.c.store.read_raw(oid)
+                if was_remote:
+                    self.staged_bytes += meta.size
                 self._node_reply(node, p["req_id"], found=True, enc="blob",
                                  data=blob, size=meta.size,
                                  meta_len=meta.meta_len,
@@ -576,6 +697,7 @@ class ClusterServer:
                     and not rec.cancelled):
                 rec.retries_left -= 1
                 rec.node_id = None  # re-placed from scratch
+                rec.fwd_seq = None
                 c._enqueue_ready(rec)
             else:
                 c._fail_task(rec, exc.WorkerCrashedError(
@@ -601,7 +723,10 @@ class ClusterServer:
         return [{"node_id": n.node_id, "alive": n.alive, "host": n.host,
                  "resources": dict(n.resources),
                  "available": dict(n.available),
-                 "inflight": len(n.inflight), "actors": len(n.actors)}
+                 "inflight": len(n.inflight), "actors": len(n.actors),
+                 "data_addr": n.data_addr,
+                 "direct_pull_bytes": n.direct_pull_bytes,
+                 "direct_serve_bytes": n.direct_serve_bytes}
                 for n in self.nodes.values()]
 
     def totals(self) -> Dict[str, float]:

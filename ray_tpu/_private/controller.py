@@ -64,6 +64,7 @@ class TaskRecord:
     pinned_actors: List[str] = field(default_factory=list)
     pinned_streams: List[str] = field(default_factory=list)
     node_id: Optional[str] = None  # set when forwarded to a cluster node
+    fwd_seq: Optional[int] = None  # per-node ship sequence (cluster.py stats)
 
 
 class _ReadyIndex:
@@ -2421,7 +2422,11 @@ class Controller:
                      "resources": dict(self.total),
                      "available": dict(self.available),
                      "object_store_used": self.store_used,
-                     "object_store_capacity": self.store_capacity}]
+                     "object_store_capacity": self.store_capacity,
+                     # node↔node bytes the head had to stage (fallback path;
+                     # ~0 when the direct data plane is healthy)
+                     "staged_bytes": (self.cluster.staged_bytes
+                                      if self.cluster is not None else 0)}]
             if self.cluster is not None:
                 rows.extend(self.cluster.node_rows())
             return rows

@@ -158,7 +158,24 @@ def _iter_tfrecord_frames(fp: str):
             (length,) = struct.unpack("<Q", head)
             f.read(4)  # length crc (unchecked: we are not guarding disk ECC)
             data = f.read(length)
-            f.read(4)  # data crc
+            (data_crc,) = struct.unpack("<I", f.read(4))
+            # verify like TF's RecordReader: a wrong masked crc32c means a
+            # corrupt or foreign-checksum file — fail loudly, not garbage.
+            # Files from this library's pre-crc32c writer (zlib.crc32 masks)
+            # still load, with a warning, so upgrading can't strand data.
+            if data_crc != _masked_crc(data):
+                if data_crc == _masked_crc_legacy(data):
+                    import warnings
+                    warnings.warn(
+                        f"{fp}: legacy zlib-crc32 TFRecord masks (written "
+                        f"by an older ray_tpu); readable here but real "
+                        f"TensorFlow readers will reject this file — "
+                        f"rewrite with write_tfrecords for TF interop.",
+                        stacklevel=2)
+                else:
+                    raise ValueError(
+                        f"{fp}: TFRecord data crc mismatch (corrupt file, "
+                        f"or written with a non-crc32c writer)")
             yield data
 
 
@@ -225,11 +242,45 @@ def _encode_example(row: Dict) -> bytes:
 _CRC_TABLE = None
 
 
+_CRC32C_TABLE = None
+
+
+def _crc32c(data: bytes) -> int:
+    """CRC-32C (Castagnoli, reflected poly 0x82F63B78) — the checksum real
+    TensorFlow readers VERIFY on every TFRecord; plain zlib.crc32 here made
+    our files read as corrupt to TF (r4 ADVICE). Uses the `crc32c` package
+    when importable, else a table-driven pure-Python fallback (fine at
+    data-export sizes; check value: crc32c(b'123456789') == 0xE3069283)."""
+    try:
+        import crc32c as _c
+        return _c.crc32c(data)
+    except ImportError:
+        pass
+    global _CRC32C_TABLE
+    if _CRC32C_TABLE is None:
+        table = []
+        for i in range(256):
+            crc = i
+            for _ in range(8):
+                crc = (crc >> 1) ^ 0x82F63B78 if crc & 1 else crc >> 1
+            table.append(crc)
+        _CRC32C_TABLE = table
+    crc = 0xFFFFFFFF
+    for b in data:
+        crc = _CRC32C_TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+    return crc ^ 0xFFFFFFFF
+
+
 def _masked_crc(data: bytes) -> int:
+    crc = _crc32c(data)
+    return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def _masked_crc_legacy(data: bytes) -> int:
+    """Mask over zlib.crc32 — what this library wrote before r5. Only used
+    to keep old self-written files readable (with a warning)."""
     import zlib
-    crc = zlib.crc32(data)  # NOTE: tf uses crc32c; plain crc32 here — we
-    # never verify on read, and files are marked via this same writer. For
-    # TF interop of OUR files, install crc32c and swap this fn.
+    crc = zlib.crc32(data)
     return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
 
 

@@ -36,7 +36,15 @@ def _set_current_model_id(model_id: str):
 
 class _ModelCache:
     """Per-replica LRU of loaded models; eviction calls the model's
-    `unload()`/`__del__` like the reference's wrapper."""
+    `unload()`/`__del__` like the reference's wrapper.
+
+    In-use protection (r4 ADVICE): every get_model takes a LEASE bound to
+    the calling asyncio task (the replica runs one task per request), and
+    eviction skips models with live leases — a long request on model A no
+    longer has A's device memory unloaded underneath it when other models
+    load concurrently (the reference wrapper keeps per-model in-use counts
+    the same way). If every cached model is leased, the cache temporarily
+    overflows and re-enforces the cap as leases drain."""
 
     def __init__(self, loader: Callable, max_models: int):
         self.loader = loader
@@ -44,8 +52,51 @@ class _ModelCache:
         self.models: "collections.OrderedDict[str, Any]" = \
             collections.OrderedDict()
         self._loading: dict = {}  # model_id -> asyncio.Future
+        self.in_use: collections.Counter = collections.Counter()
+
+    def _lease(self, model_id: str):
+        """Pin `model_id` until the calling request task finishes."""
+        task = asyncio.current_task()
+        if task is None:
+            return
+        self.in_use[model_id] += 1
+
+        def _release(_t, mid=model_id):
+            self.in_use[mid] -= 1
+            if self.in_use[mid] <= 0:
+                del self.in_use[mid]
+                if len(self.models) > self.max_models:
+                    # cap was overflowed while every model was leased; trim
+                    # back to EXACTLY max_models (limit=max+1: _evict_to
+                    # stops at len < limit — passing max here would land at
+                    # max-1 and near-simultaneous releases could empty the
+                    # cache entirely)
+                    asyncio.get_running_loop().create_task(
+                        self._evict_to(self.max_models + 1))
+
+        task.add_done_callback(_release)
+
+    async def _evict_to(self, limit: int):
+        # LRU order, but never unload a model a live request still uses
+        while len(self.models) >= limit:
+            victim = next((mid for mid in self.models
+                           if not self.in_use.get(mid)), None)
+            if victim is None:
+                return  # all leased: allow temporary overflow
+            old = self.models.pop(victim)
+            unload = getattr(old, "unload", None)
+            if callable(unload):
+                maybe = unload()
+                if asyncio.iscoroutine(maybe):
+                    await maybe
+            del old
 
     async def get_model(self, owner, model_id: str):
+        model = await self._get_or_load(owner, model_id)
+        self._lease(model_id)
+        return model
+
+    async def _get_or_load(self, owner, model_id: str):
         if model_id in self.models:
             self.models.move_to_end(model_id)
             return self.models[model_id]
@@ -55,27 +106,17 @@ class _ModelCache:
         loop = asyncio.get_running_loop()
         fut = loop.create_future()
         self._loading[model_id] = fut
-        async def _evict_to(limit: int):
-            while len(self.models) >= limit:
-                _old_id, old = self.models.popitem(last=False)
-                unload = getattr(old, "unload", None)
-                if callable(unload):
-                    maybe = unload()
-                    if asyncio.iscoroutine(maybe):
-                        await maybe
-                del old
-
         try:
             # evict BEFORE loading: if max_models models fill the device,
             # holding N+1 during the load would OOM exactly when the cap is
             # sized to the hardware
-            await _evict_to(self.max_models)
+            await self._evict_to(self.max_models)
             out = self.loader(owner, model_id)
             if asyncio.iscoroutine(out):
                 out = await out
             # concurrent loads of DISTINCT models can each pass the first
             # eviction check; re-enforce the cap before inserting
-            await _evict_to(self.max_models)
+            await self._evict_to(self.max_models)
             self.models[model_id] = out
             fut.set_result(out)
             return out

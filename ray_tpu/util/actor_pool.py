@@ -11,11 +11,11 @@ V = TypeVar("V")
 class ActorPool:
     def __init__(self, actors: List[Any]):
         self._idle = list(actors)
-        self._future_to_actor = {}
-        self._index_to_future = {}
-        self._next_task_index = 0
-        self._next_return_index = 0
-        self._pending_submits = []
+        self._actor_of_ref = {}
+        self._inflight_by_seq = {}
+        self._submit_seq = 0
+        self._drain_seq = 0
+        self._backlog = []
 
     # -- submission ----------------------------------------------------------
     def submit(self, fn: Callable[[Any, V], Any], value: V):
@@ -23,33 +23,36 @@ class ActorPool:
         if self._idle:
             actor = self._idle.pop()
             future = fn(actor, value)
-            self._future_to_actor[future] = actor
-            self._index_to_future[self._next_task_index] = future
-            self._next_task_index += 1
+            self._actor_of_ref[future] = actor
+            self._inflight_by_seq[self._submit_seq] = future
+            self._submit_seq += 1
         else:
-            self._pending_submits.append((fn, value))
+            self._backlog.append((fn, value))
 
     def _maybe_drain_pending(self):
-        while self._idle and self._pending_submits:
-            fn, value = self._pending_submits.pop(0)
+        while self._idle and self._backlog:
+            fn, value = self._backlog.pop(0)
             self.submit(fn, value)
 
     # -- retrieval -----------------------------------------------------------
     def has_next(self) -> bool:
-        return bool(self._index_to_future) or bool(self._pending_submits)
+        return bool(self._inflight_by_seq) or bool(self._backlog)
 
     def get_next(self, timeout=None):
-        """Next result in submission order."""
+        """Next result in submission order. Seqs already taken by
+        get_next_unordered leave gaps in the inflight map — skip them
+        instead of spinning (mixing the two collectors is supported)."""
         import ray_tpu
         if not self.has_next():
             raise StopIteration("no pending results")
-        idx = self._next_return_index
-        while idx not in self._index_to_future:
-            self._maybe_drain_pending()
-            if not self._index_to_future:
-                raise StopIteration("no pending results")
-        future = self._index_to_future.pop(idx)
-        self._next_return_index += 1
+        self._maybe_drain_pending()
+        idx = self._drain_seq
+        while idx < self._submit_seq and idx not in self._inflight_by_seq:
+            idx += 1  # submitted but absent → collected unordered
+        if idx not in self._inflight_by_seq:
+            raise StopIteration("no pending results")
+        future = self._inflight_by_seq.pop(idx)
+        self._drain_seq = idx + 1
         value = ray_tpu.get(future, timeout=timeout)
         self._return_actor(future)
         return value
@@ -58,27 +61,27 @@ class ActorPool:
         """Whichever pending result finishes first."""
         import ray_tpu
         self._maybe_drain_pending()
-        if not self._index_to_future:
+        if not self._inflight_by_seq:
             raise StopIteration("no pending results")
-        futures = list(self._index_to_future.values())
+        futures = list(self._inflight_by_seq.values())
         ready, _ = ray_tpu.wait(futures, num_returns=1, timeout=timeout)
         if not ready:
             raise TimeoutError("no result within timeout")
         future = ready[0]
-        for i, f in list(self._index_to_future.items()):
+        for i, f in list(self._inflight_by_seq.items()):
             if f == future:
-                del self._index_to_future[i]
-                if i == self._next_return_index:
-                    while self._next_return_index not in self._index_to_future \
-                            and self._next_return_index < self._next_task_index:
-                        self._next_return_index += 1
+                del self._inflight_by_seq[i]
+                if i == self._drain_seq:
+                    while self._drain_seq not in self._inflight_by_seq \
+                            and self._drain_seq < self._submit_seq:
+                        self._drain_seq += 1
                 break
         value = ray_tpu.get(future)
         self._return_actor(future)
         return value
 
     def _return_actor(self, future):
-        actor = self._future_to_actor.pop(future, None)
+        actor = self._actor_of_ref.pop(future, None)
         if actor is not None:
             self._idle.append(actor)
             self._maybe_drain_pending()
@@ -99,7 +102,7 @@ class ActorPool:
 
     # -- membership ----------------------------------------------------------
     def has_free(self) -> bool:
-        return bool(self._idle) and not self._pending_submits
+        return bool(self._idle) and not self._backlog
 
     def push(self, actor):
         self._idle.append(actor)

@@ -104,3 +104,55 @@ def test_streaming_split_two_train_workers_disjoint(ray_session):
     assert sorted(seen[0] + seen[1]) == list(range(2000))
     # equal=True: block-granular balance (8 blocks -> 4/4)
     assert len(seen[0]) == len(seen[1]) == 1000
+
+
+def test_tfrecord_crc32c_check_value():
+    """The TFRecord masks are real CRC-32C (Castagnoli): TF's RecordReader
+    verifies them and rejected our zlib.crc32 files as corrupt (r4 ADVICE).
+    0xE3069283 is the standard crc32c check value for b'123456789'."""
+    from ray_tpu.data.readers import _crc32c, _masked_crc
+    assert _crc32c(b"123456789") == 0xE3069283
+    assert _crc32c(b"") == 0
+    # mask formula from tensorflow/core/lib/hash/crc32c.h
+    crc = _crc32c(b"hello")
+    assert _masked_crc(b"hello") == (((crc >> 15) | (crc << 17))
+                                     + 0xA282EAD8) & 0xFFFFFFFF
+
+
+def test_tfrecord_reader_rejects_corrupt_crc(tmp_path, ray_session):
+    import pytest as _pytest
+
+    from ray_tpu import data as rdata
+    path = str(tmp_path / "bad.tfrecord")
+    rdata.write_tfrecords([{"x": 1}], path)
+    blob = bytearray(open(path, "rb").read())
+    blob[-13] ^= 0xFF  # flip a payload byte; trailing data-crc now lies
+    open(path, "wb").write(bytes(blob))
+    with _pytest.raises(Exception, match="crc mismatch"):
+        rdata.read_tfrecords(path).take_all()
+
+
+def test_tfrecord_legacy_zlib_files_still_read(tmp_path, ray_session):
+    """Files written by the pre-r5 writer (zlib.crc32 masks) load with a
+    warning instead of stranding user data behind the new verification."""
+    import struct
+    import warnings
+
+    from ray_tpu import data as rdata
+    from ray_tpu.data.readers import _encode_example, _masked_crc_legacy
+    path = str(tmp_path / "legacy.tfrecord")
+    with open(path, "wb") as f:  # replica of the old writer
+        data = _encode_example({"x": 7})
+        f.write(struct.pack("<Q", len(data)))
+        f.write(struct.pack("<I", _masked_crc_legacy(struct.pack("<Q", len(data)))))
+        f.write(data)
+        f.write(struct.pack("<I", _masked_crc_legacy(data)))
+    rows = rdata.read_tfrecords(path).take_all()  # executes in a worker
+    assert rows[0]["x"] == 7
+    # warning is emitted where the frames are parsed (worker above, local
+    # here) — assert it on a local parse
+    from ray_tpu.data.readers import _iter_tfrecord_frames
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        assert len(list(_iter_tfrecord_frames(path))) == 1
+    assert any("legacy zlib-crc32" in str(x.message) for x in w)

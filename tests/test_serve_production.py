@@ -220,3 +220,58 @@ def test_grpc_ingress_roundtrip(serve_app):
     assert ei.value.code() == grpc.StatusCode.INTERNAL
     ch.close()
     serve.delete("calc")
+
+
+def test_multiplex_eviction_spares_in_use_models():
+    """LRU eviction must not unload a model a live request still holds
+    (r4 ADVICE): leases bound to the calling task defer eviction until the
+    request drains, temporarily overflowing the cap instead."""
+    import asyncio
+
+    from ray_tpu.serve.multiplex import _ModelCache
+
+    unloaded = []
+
+    class Model:
+        def __init__(self, mid):
+            self.mid = mid
+
+        def unload(self):
+            unloaded.append(self.mid)
+
+    async def scenario():
+        cache = _ModelCache(lambda owner, mid: Model(mid), max_models=1)
+        release_a = asyncio.Event()
+        a_model = {}
+
+        async def long_request_on_a():
+            a_model["m"] = await cache.get_model(None, "A")
+            await release_a.wait()
+            return a_model["m"].mid
+
+        t1 = asyncio.ensure_future(long_request_on_a())
+        await asyncio.sleep(0.05)
+        assert "A" in cache.models
+
+        # B loads while A is leased: A must NOT be unloaded under t1
+        release_b = asyncio.Event()
+
+        async def long_request_on_b():
+            m = await cache.get_model(None, "B")
+            await release_b.wait()
+            return m.mid
+
+        t2 = asyncio.ensure_future(long_request_on_b())
+        await asyncio.sleep(0.05)
+        assert unloaded == [], unloaded          # A survived (leased)
+        assert len(cache.models) == 2            # temporary overflow
+
+        release_a.set()
+        assert await t1 == "A"
+        await asyncio.sleep(0.05)  # lease-drain eviction task runs
+        assert unloaded == ["A"]                 # A drained first → evicted
+        assert list(cache.models) == ["B"]
+        release_b.set()
+        assert await t2 == "B"
+
+    asyncio.run(scenario())

@@ -177,3 +177,26 @@ def test_cli_topology(monkeypatch, capsys):
     main(["topology"])
     out = capsys.readouterr().out
     assert '"generation": "v5e"' in out
+
+
+def test_actor_pool_mixed_ordered_unordered(ray_session):
+    """get_next after get_next_unordered consumed an out-of-order seq must
+    skip the gap, not spin (r5 review): 3 tasks, take one unordered, then
+    drain the rest in order."""
+    import ray_tpu as ray
+    from ray_tpu.util import ActorPool
+
+    @ray.remote
+    class A:
+        def echo(self, v):
+            return v
+
+    pool = ActorPool([A.remote() for _ in range(3)])
+    for v in (10, 11, 12):
+        pool.submit(lambda a, v: a.echo.remote(v), v)
+    first = pool.get_next_unordered(timeout=60)
+    rest = []
+    while pool.has_next():
+        rest.append(pool.get_next(timeout=60))
+    assert sorted([first] + rest) == [10, 11, 12]
+    assert rest == sorted(rest)  # ordered drain stays in submission order
