@@ -216,6 +216,86 @@ class Dataset:
     def groupby(self, key: str) -> "GroupedData":
         return GroupedData(self, key)
 
+    # ------------------------------------------------------------ relational
+    def _hash_shuffled(self, keys: List[str], num_partitions: int,
+                       tag: str) -> "Dataset":
+        """Key-hash partitioning on the existing ShuffleOp: every occurrence
+        of a key lands in exactly one partition; partitions emit in order.
+        The hash is pandas' deterministic row hash, so BOTH sides of a join
+        route identically regardless of which worker maps the block."""
+        def _map(blk, n_parts, idx):
+            if blk.num_rows == 0:
+                return tuple(blk.slice(0, 0) for _ in range(n_parts))
+            import pandas as pd
+            kdf = blk.select(keys).to_pandas()
+            h = pd.util.hash_pandas_object(kdf, index=False).to_numpy()
+            part = (h % np.uint64(n_parts)).astype(np.int64)
+            return tuple(blk.filter(pa.array(part == p))
+                         for p in range(n_parts))
+
+        def _reduce(parts, p):
+            return B.block_concat(parts) if parts else pa.table({})
+
+        return Dataset(self._plan.with_op(ShuffleOp(
+            tag, _map, _reduce, num_partitions=num_partitions)))
+
+    def join(self, other: "Dataset", on: Union[str, List[str]], *,
+             how: str = "inner", num_partitions: int = 16,
+             suffixes: Tuple[str, str] = ("", "_1")) -> "Dataset":
+        """Distributed hash join (ref: python/ray/data/dataset.py:2893 join
+        — the reference shuffles both sides by key hash through its exchange
+        operators and joins per partition; same shape here on ShuffleOp).
+        Both sides hash-partition on `on`; partition i of the left joins
+        partition i of the right in its own task, so no process ever holds
+        more than ~1/num_partitions of either side. Lazy: the side shuffles
+        execute when the joined dataset is consumed; in streaming mode the
+        partition blocks travel worker→worker as refs, never through the
+        driver."""
+        if how not in ("inner", "left", "right", "outer"):
+            raise ValueError(f"unsupported join type {how!r}")
+        keys = [on] if isinstance(on, str) else list(on)
+        lhs = self._hash_shuffled(keys, num_partitions, "join.lhs")
+        rhs = other._hash_shuffled(keys, num_partitions, "join.rhs")
+
+        def _build():
+            from .plan import _runtime_up
+            if _runtime_up():
+                # drain the two side shuffles CONCURRENTLY (client is
+                # thread-safe — lock + recv thread): join wall-clock is
+                # ~max(shuffle(lhs), shuffle(rhs)), not their sum
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(max_workers=1) as pool:
+                    rfut = pool.submit(
+                        lambda: [r for r, _ in rhs._plan.iter_block_refs()])
+                    lrefs = [r for r, _ in lhs._plan.iter_block_refs()]
+                    rrefs = rfut.result()
+                return [
+                    (lambda lr=lr, rr=rr: _pair_join_refs(
+                        lr, rr, keys, how, suffixes))
+                    for lr, rr in zip(lrefs, rrefs)]
+            # inline: a side yields all its partitions (schema-preserving
+            # 0-row blocks included) unless it has no blocks at all — pad
+            # a fully-empty side with Nones so `how` semantics still apply
+            lblocks = list(lhs._plan.iter_blocks())
+            rblocks = list(rhs._plan.iter_blocks())
+            n = max(len(lblocks), len(rblocks), 1)
+            lblocks = lblocks or [None] * n
+            rblocks = rblocks or [None] * n
+            return [(lambda lb=lb, rb=rb: _pair_join_blocks(
+                        lb, rb, keys, how, suffixes))
+                    for lb, rb in zip(lblocks, rblocks)]
+
+        from .plan import DeferredSource
+        return Dataset(Plan(DeferredSource(_build, "join")))
+
+    def unique(self, column: str) -> List[Any]:
+        """Distinct values of `column` (ref: dataset.py:3132 unique —
+        implemented there as a count() groupby; same here: the streaming
+        range-partition groupby dedups, the driver collects only the
+        already-unique values)."""
+        rows = self.select_columns([column]).groupby(column).count().take_all()
+        return [r[column] for r in rows]
+
     # ---------------------------------------------------------------- splits
     def streaming_split(self, n: int, *, equal: bool = False,
                         locality_hints=None):
@@ -488,6 +568,30 @@ class GroupedData:
     def std(self, on: Optional[str] = None) -> Dataset:
         return self._agg("std", on)
 
+    def map_groups(self, fn, *, batch_format: str = "pandas") -> Dataset:
+        """Apply `fn` to each whole group (ref: grouped_data.py map_groups):
+        the range-partition shuffle lands every occurrence of a key in one
+        partition, so each group is seen exactly once, by one task. `fn`
+        gets the group as a pandas DataFrame ("pandas") or dict of numpy
+        arrays ("numpy") and may return either, or a list of row dicts."""
+        key = self._key
+
+        def _per_partition(df):
+            import pandas as pd
+            outs = []
+            for _k, g in df.groupby(key, sort=True):
+                g = g.reset_index(drop=True)
+                arg = ({c: g[c].to_numpy() for c in g.columns}
+                       if batch_format == "numpy" else g)
+                out = fn(arg)
+                if isinstance(out, (dict, list)):
+                    out = pd.DataFrame(out)
+                outs.append(out)
+            return (pd.concat(outs, ignore_index=True) if outs
+                    else df.iloc[0:0])
+
+        return self._shuffled_agg("map_groups", _per_partition)
+
     def aggregate(self, *aggs) -> Dataset:
         """aggs: ("sum", col) tuples or names from _AGGS."""
         key = self._key
@@ -506,6 +610,27 @@ class GroupedData:
             return pd.concat(pieces, axis=1).reset_index()
 
         return self._shuffled_agg("groupby.agg", _per_partition)
+
+
+def _pair_join_blocks(lb, rb, keys, how, suffixes) -> pa.Table:
+    """Join one aligned partition pair. A None / schema-less side stands in
+    for 'this side is completely empty' — modeled as an empty frame with
+    just the key columns so pandas merge still applies `how` semantics."""
+    import pandas as pd
+
+    def _df(blk):
+        if blk is None or blk.num_columns == 0:
+            return pd.DataFrame({k: [] for k in keys})
+        return blk.to_pandas()
+
+    merged = _df(lb).merge(_df(rb), on=keys, how=how, suffixes=suffixes)
+    return pa.Table.from_pandas(merged, preserve_index=False)
+
+
+def _pair_join_refs(lref, rref, keys, how, suffixes) -> pa.Table:
+    import ray_tpu
+    lb, rb = ray_tpu.get([lref, rref])
+    return _pair_join_blocks(lb, rb, keys, how, suffixes)
 
 
 def from_blocks(blocks: List[pa.Table]) -> Dataset:

@@ -40,6 +40,24 @@ class Source:
     name: str = "source"
 
 
+class DeferredSource:
+    """A Source whose thunks are BUILT at first access (= at iteration):
+    lets a plan's source depend on executing other plans (join runs both
+    sides' hash shuffles when the joined dataset is consumed) while keeping
+    dataset construction lazy."""
+
+    def __init__(self, builder: Callable[[], List[Callable]], name: str):
+        self._builder = builder
+        self._thunks: Optional[List[Callable]] = None
+        self.name = name
+
+    @property
+    def thunks(self) -> List[Callable]:
+        if self._thunks is None:
+            self._thunks = self._builder()
+        return self._thunks
+
+
 @dataclass
 class Stats:
     op_time_s: Dict[str, float] = field(default_factory=dict)
@@ -99,7 +117,15 @@ class Plan:
             return self._iter_streaming()
         return self._iter_inline()
 
-    def _iter_streaming(self) -> Iterator[pa.Table]:
+    def iter_block_refs(self):
+        """Streaming-mode only: (ref, nbytes) per output block, bytes never
+        pulled to the driver, schema-less empties KEPT (positional
+        consumers — join's partition pairing — need all partitions)."""
+        if not _runtime_up():
+            raise RuntimeError("iter_block_refs requires a live runtime")
+        return self._iter_streaming(materialize=False)
+
+    def _iter_streaming(self, materialize: bool = True) -> Iterator[pa.Table]:
         stats = self.stats
 
         def seg_stages(stage_list):
@@ -133,7 +159,7 @@ class Plan:
             ex = StreamingExecutor(thunks, seg_stages(seg), stats,
                                    self.op_budget)
             self.last_executor = ex
-            yield from ex.run()
+            yield from ex.run(materialize=materialize)
         return gen()
 
     def _iter_inline(self) -> Iterator[pa.Table]:

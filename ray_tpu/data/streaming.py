@@ -356,12 +356,19 @@ class StreamingExecutor:
         self._account()
 
     # ----------------------------------------------------------------- run
-    def run(self) -> Iterator[pa.Table]:
+    def run(self, materialize: bool = True) -> Iterator[pa.Table]:
+        """materialize=False yields (ref, nbytes) pairs WITHOUT pulling
+        block bytes to the driver and without dropping schema-less empties
+        — consumers that pair partition outputs positionally (join) need
+        every partition, and the bytes should go worker→worker."""
         sink = self.chain[-1]
         while True:
             while sink.outq:
                 ref, nbytes = sink.outq.popleft()
                 sink.out_bytes -= nbytes
+                if not materialize:
+                    yield ref, nbytes
+                    continue
                 blk = self._ray.get(ref)
                 if blk.num_columns == 0 and blk.num_rows == 0:
                     continue  # schema-less empty (e.g. a starved reduce)
