@@ -131,7 +131,8 @@ class Attention(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, positions, cache: Optional[KVCache]):
+    def __call__(self, x, positions, cache: Optional[KVCache],
+                 paged_chunk_local: bool = False):
         cfg = self.cfg
         layer_idx = self.layer_idx
         dense = partial(nn.Dense, use_bias=False, dtype=cfg.dtype,
@@ -163,16 +164,37 @@ class Attention(nn.Module):
                 out = impl(q[:, 0], cache.k_pages[layer_idx],
                            cache.v_pages[layer_idx], cache.block_tables,
                            positions[:, -1] + 1)[:, None]
-            else:
-                # prefill of a fresh row: nothing cached to read back, so
-                # plain causal attention over the prompt is exact. Honors
-                # attn_impl like the cache=None branch ("ring" needs an sp
-                # mesh axis that the serving path doesn't have → xla).
+            elif paged_chunk_local:
+                # FIRST chunk of a fresh row (start==0, no cached prefix —
+                # the caller asserts this statically): chunk-local causal
+                # attention is exact, no page gather. The hot cold-prompt
+                # TTFT path; honors attn_impl like the cache=None branch.
                 impl = cfg.attn_impl
                 if impl in ("auto", "ring"):
                     impl = "flash" if jax.default_backend() == "tpu" else "xla"
                 out = (flash_attention(q, k, v, causal=True) if impl == "flash"
                        else mha_reference(q, k, v, causal=True))
+            else:
+                # chunked prefill continuation: queries must see the row's
+                # CACHED prefix (chunks 2+ of a long prompt, and
+                # prefix-cache hits start mid-prompt), not just their own
+                # chunk — chunk-local causal attention here was the r4 bug
+                # that made multi-chunk paged prefill numerically wrong.
+                # Gather the row's pages into contiguous KV (slot s =
+                # absolute position s; the padded table's placeholder pages
+                # sit past every valid query position and are masked) and
+                # reuse decode_attention's absolute-position causal mask.
+                # B is 1 here (row view), so the gather is one row's
+                # capacity per layer.
+                kp = cache.k_pages[layer_idx]      # [Kh, P, ps, D]
+                vp = cache.v_pages[layer_idx]
+                tb = cache.block_tables            # [B, mp]
+                kh_, d_ = kp.shape[0], kp.shape[-1]
+                k_all = kp[:, tb].transpose(1, 2, 3, 0, 4).reshape(
+                    b, -1, kh_, d_)
+                v_all = vp[:, tb].transpose(1, 2, 3, 0, 4).reshape(
+                    b, -1, kh_, d_)
+                out = decode_attention(q, k_all, v_all, positions[:, 0])
             new_cache_kv = cache
         elif cache is not None:
             # Decode: write current K/V at `length`, attend over the cache.
@@ -218,11 +240,11 @@ class Block(nn.Module):
     layer_idx: int = 0
 
     @nn.compact
-    def __call__(self, x, positions, cache):
+    def __call__(self, x, positions, cache, paged_chunk_local=False):
         cfg = self.cfg
         h, new_kv = Attention(cfg, self.layer_idx, name="attn")(
             RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x),
-            positions, cache)
+            positions, cache, paged_chunk_local)
         x = x + h
         x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(x))
         return x, new_kv
@@ -233,11 +255,16 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, positions=None, cache: Optional[KVCache] = None,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, paged_chunk_local: bool = False):
         """tokens [B, T] int32 → logits [B, T, V] (f32), new cache (or None).
 
         Prefill/train: cache=None, full causal attention. Decode: pass a
         KVCache; T is the number of new tokens (usually 1).
+
+        `paged_chunk_local=True` (static; paged prefill only): the chunk is
+        the FIRST tokens of a fresh row (start==0, no cached prefix), so
+        chunk-local causal attention is exact and skips the full-row page
+        gather — the hot cold-prompt path.
 
         `return_hidden=True` returns the final-norm hidden states [B, T, D]
         instead of logits — callers fuse the lm_head into a chunked loss
@@ -263,7 +290,8 @@ class Llama(nn.Module):
         paged = isinstance(cache, PagedKVCache)
         new_k, new_v = [], []
         for i in range(cfg.n_layers):
-            x, new_kv = block_cls(cfg, i, name=f"layers_{i}")(x, positions, cache)
+            x, new_kv = block_cls(cfg, i, name=f"layers_{i}")(
+                x, positions, cache, paged_chunk_local)
             if paged:
                 cache = new_kv  # thread the updated page pools layer→layer
             elif new_kv is not None:

