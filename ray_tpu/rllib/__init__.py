@@ -7,8 +7,9 @@ update as one jitted program on the TPU.
 
 from .algorithm import Algorithm, AlgorithmConfig
 from .algorithms import (APPO, APPOConfig, BC, BCConfig, CQL, CQLConfig, DQN,
-                         DQNConfig, IMPALA, IMPALAConfig, MARWIL,
-                         MARWILConfig, PPO, PPOConfig, SAC, SACConfig)
+                         DQNConfig, IMPALA, IMPALAConfig, IQL, IQLConfig,
+                         MARWIL, MARWILConfig, PPO, PPOConfig, SAC, SACConfig,
+                         TQC, TQCConfig)
 from .buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .env_runner import EnvRunner
 from .learner import JaxLearner, LearnerGroup, make_learner_group
@@ -21,5 +22,6 @@ __all__ = [
     "ReplayBuffer", "PrioritizedReplayBuffer",
     "PPO", "PPOConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
     "IMPALA", "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
-    "MARWIL", "MARWILConfig",
+    "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "IQL", "IQLConfig",
+    "TQC", "TQCConfig",
 ]

@@ -22,8 +22,8 @@ import numpy as np
 from .. import sample_batch as SB
 from ..algorithm import Algorithm
 from ..distributions import SquashedGaussian
-from ..offline import as_sample_batch
-from ..rl_module import ModuleSpec
+from .offline_utils import (evaluate_continuous, load_continuous_dataset,
+                            make_offline_optimizer, offline_training_step)
 from .sac import SACConfig, SACModule
 
 
@@ -52,36 +52,15 @@ class CQL(Algorithm):
     def setup(self, config: CQLConfig):
         if config.offline_data is None:
             raise ValueError("CQL needs config.offline_data")
-        batch = as_sample_batch(config.offline_data)
-        self._data = {k: np.asarray(batch[k]) for k in
-                      (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS,
-                       SB.TERMINATEDS)}
-        self._n = len(self._data[SB.OBS])
-        acts = self._data[SB.ACTIONS]
-        if acts.ndim == 1:
-            acts = acts[:, None]
-            self._data[SB.ACTIONS] = acts
-        obs_shape = self._data[SB.OBS].shape[1:]
-        action_dim = acts.shape[-1]
-        low = (config.action_low if config.action_low is not None
-               else float(acts.min()))
-        high = (config.action_high if config.action_high is not None
-                else float(acts.max()))
-        spec = ModuleSpec(obs_shape, "continuous", action_dim,
-                          tuple(config.model.get("hiddens", (256, 256))))
+        self._data, self._n, spec, low, high = \
+            load_continuous_dataset(config)
+        action_dim = spec.action_dim
         self.module = SACModule(spec, low, high)
         key = jax.random.PRNGKey(config.seed)
         self.weights = self.module.init(key)
-        from ray_tpu.ops.optim import make_optimizer
-        self.opt, self._lr_schedule = make_optimizer(
-            lr=config.lr, lr_schedule=getattr(config, "lr_schedule", None),
-            optimizer=getattr(config, "optimizer", "adam"),
-            grad_clip=getattr(config, "grad_clip", None))
-        self.opt_state = {
-            "actor": self.opt.init(self.weights["actor"]),
-            "q1": self.opt.init(self.weights["q1"]),
-            "q2": self.opt.init(self.weights["q2"]),
-            "alpha": self.opt.init(self.weights["log_alpha"])}
+        self.opt, self._lr_schedule, self.opt_state = make_offline_optimizer(
+            config, self.weights, ("actor", "q1", "q2"))
+        self.opt_state["alpha"] = self.opt.init(self.weights["log_alpha"])
         self.target_entropy = (config.target_entropy
                                if config.target_entropy is not None
                                else -float(action_dim))
@@ -204,50 +183,17 @@ class CQL(Algorithm):
     # --------------------------------------------------------------- training
     def training_step(self) -> Dict:
         cfg = self.config
-        last = {}
-        lr_used = float(self._lr_schedule(self._updates))
-        for i in range(cfg.train_intensity):
-            idx = self._rng.integers(0, self._n, size=cfg.train_batch_size)
-            mb = {k: v[idx] for k, v in self._data.items()}
-            key = jax.random.PRNGKey(cfg.seed * 100_003 + self._updates)
-            bc_phase = self._updates < cfg.bc_iters
-            # lr of the update being applied (schedule is evaluated at the
-            # pre-increment count, same convention as JaxLearner)
-            lr_used = float(self._lr_schedule(self._updates))
-            self.weights, self.opt_state, last = self._update(
-                self.weights, self.opt_state, mb, key, bc_phase)
-            self._updates += 1
-        learner = {k: float(v) for k, v in jax.device_get(last).items()}
-        learner["cur_lr"] = lr_used
-        return {"learner": learner, "num_env_steps_sampled_this_iter": 0}
+
+        def step_once(mb, i):
+            key = jax.random.PRNGKey(cfg.seed * 100_003 + i)
+            return self._update(self.weights, self.opt_state, mb, key,
+                                i < cfg.bc_iters)
+
+        return offline_training_step(self, step_once)
 
     # -------------------------------------------------------------- eval/util
     def evaluate(self) -> Dict:
-        cfg = self.config
-        if cfg.env is None:
-            return {}
-        import gymnasium as gym
-        env = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env()
-        infer = jax.jit(self.module.inference_step)
-        rets, lens = [], []
-        for ep in range(cfg.evaluation_duration):
-            obs, _ = env.reset(seed=cfg.seed + 10_000 + ep)
-            ret, n, done = 0.0, 0, False
-            while not done:
-                a, _ = infer(self.weights, obs[None].astype(np.float32))
-                a = np.clip(np.asarray(a)[0], self.module.low, self.module.high)
-                obs, r, term, trunc, _ = env.step(a)
-                ret += float(r)
-                n += 1
-                done = term or trunc
-            rets.append(ret)
-            lens.append(n)
-        env.close()
-        return {"episodes_this_iter": len(rets),
-                "episode_return_mean": float(np.mean(rets)),
-                "episode_return_max": float(np.max(rets)),
-                "episode_return_min": float(np.min(rets)),
-                "episode_len_mean": float(np.mean(lens))}
+        return evaluate_continuous(self)
 
     def get_weights(self):
         return jax.device_get(self.weights)

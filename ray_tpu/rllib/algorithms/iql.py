@@ -1,0 +1,178 @@
+"""IQL — Implicit Q-Learning (reference: rllib/algorithms/iql/iql.py,
+iql_learner.py; paper arXiv:2110.06169).
+
+Offline RL that never queries Q on out-of-distribution actions:
+- a value net V(s) is fit to the twin-target-Q by EXPECTILE regression
+  (asymmetric L2, expectile tau > 0.5 biases V toward the upper envelope
+  of behavior-supported Q values),
+- the critics regress the one-step Bellman target r + gamma*(1-d)*V(s')
+  (no action sampling at s' at all),
+- the actor is advantage-weighted regression: maximize
+  exp(beta * (Q_target(s,a) - V(s))) * log pi(a|s) with clipped weights.
+
+tpu-first: all three fits live in ONE jitted update (value, critics, actor,
+polyak) so XLA fuses the shared forward passes; data stays device-resident
+between the train_intensity SGD steps.
+
+Contrast with the reference: rllib's IQLLearner subclasses the MARWIL torch
+learner and splits per-net optimizers across `actor_lr/critic_lr/value_lr`;
+here one optax optimizer per net inside a single jit, same hyperparameters
+(expectile, beta, twin_q, tau — iql.py:60-82).
+"""
+
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_tpu.models.torsos import MLPTorso
+from .. import sample_batch as SB
+from ..algorithm import Algorithm
+from ..distributions import SquashedGaussian
+from ..rl_module import ModuleSpec
+from .offline_utils import (evaluate_continuous, load_continuous_dataset,
+                            make_offline_optimizer, offline_training_step)
+from .sac import SACConfig, SACModule
+
+
+class _ValueNet(nn.Module):
+    spec: ModuleSpec
+
+    @nn.compact
+    def __call__(self, obs):
+        z = MLPTorso(self.spec.hiddens)(obs.reshape(obs.shape[0], -1))
+        return nn.Dense(1, name="v")(z)[:, 0]
+
+
+class IQLConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = IQL
+        self.offline_data = None
+        self.expectile = 0.8        # ref iql.py:69 (tau in the paper)
+        self.beta = 0.1             # AWR temperature, ref iql.py:66
+        self.awr_weight_cap = 100.0  # exp-advantage clip (paper appendix)
+        self.train_intensity = 8
+        self.action_low = None
+        self.action_high = None
+
+    def offline_data_source(self, data):
+        self.offline_data = data
+        return self
+
+
+class IQL(Algorithm):
+    _supports_eval_actors = False
+
+    def setup(self, config: IQLConfig):
+        if config.offline_data is None:
+            raise ValueError("IQL needs config.offline_data")
+        self._data, self._n, spec, low, high = \
+            load_continuous_dataset(config)
+        self.module = SACModule(spec, low, high)
+        self.value = _ValueNet(spec)
+        key = jax.random.PRNGKey(config.seed)
+        self.weights = self.module.init(key)
+        obs0 = jnp.zeros((1,) + spec.obs_shape, jnp.float32)
+        self.weights["value"] = self.value.init(
+            jax.random.fold_in(key, 7), obs0)
+        self.opt, self._lr_schedule, self.opt_state = make_offline_optimizer(
+            config, self.weights, ("actor", "q1", "q2", "value"))
+        self._rng = np.random.default_rng(config.seed)
+        self._updates = 0
+        self._build_update()
+
+    def _build_update(self):
+        cfg = self.config
+        mod = self.module
+        val = self.value
+        gamma, tau = cfg.gamma, cfg.tau
+        expectile = cfg.expectile
+        beta = cfg.beta
+        w_cap = cfg.awr_weight_cap
+        low, high = mod.low, mod.high
+
+        def update(w, opt_state, batch):
+            import optax
+            obs, act = batch[SB.OBS], batch[SB.ACTIONS]
+            nxt, rew = batch[SB.NEXT_OBS], batch[SB.REWARDS]
+            done = batch[SB.TERMINATEDS]
+
+            # -- value net: expectile regression toward min target-Q(s, a_data)
+            q1_t = mod.critic.apply(w["q1_target"], obs, act)
+            q2_t = mod.critic.apply(w["q2_target"], obs, act)
+            q_t = jax.lax.stop_gradient(jnp.minimum(q1_t, q2_t))
+
+            def v_loss(vp):
+                v = val.apply(vp, obs)
+                diff = q_t - v
+                # L2^tau: weight tau where Q>V, (1-tau) where Q<V
+                wgt = jnp.where(diff > 0, expectile, 1 - expectile)
+                return jnp.mean(wgt * jnp.square(diff)), v
+
+            (lv, v), gv = jax.value_and_grad(v_loss, has_aux=True)(w["value"])
+            uv, opt_v = self.opt.update(gv, opt_state["value"], w["value"])
+            value_p = optax.apply_updates(w["value"], uv)
+
+            # -- critics: Bellman toward V(s') — no next-action sampling
+            v_next = jax.lax.stop_gradient(val.apply(value_p, nxt))
+            target = rew + gamma * (1 - done) * v_next
+
+            def q_loss(qp):
+                q = mod.critic.apply(qp, obs, act)
+                return jnp.mean(jnp.square(q - target))
+
+            l1, g1 = jax.value_and_grad(q_loss)(w["q1"])
+            l2, g2 = jax.value_and_grad(q_loss)(w["q2"])
+            u1, opt_q1 = self.opt.update(g1, opt_state["q1"], w["q1"])
+            u2, opt_q2 = self.opt.update(g2, opt_state["q2"], w["q2"])
+            q1p = optax.apply_updates(w["q1"], u1)
+            q2p = optax.apply_updates(w["q2"], u2)
+
+            # -- actor: AWR with exp-advantage weights (advantage from the
+            # TARGET critics and the fresh V, both stop-gradiented)
+            adv = q_t - jax.lax.stop_gradient(v)
+            awr_w = jnp.minimum(jnp.exp(beta * adv), w_cap)
+
+            def pi_loss(ap):
+                mean, log_std = mod.actor.apply(ap, obs)
+                dist = SquashedGaussian(mean, log_std, low, high)
+                logp = dist.log_prob(act)
+                return -jnp.mean(awr_w * logp), logp
+
+            (la, logp), ga = jax.value_and_grad(
+                pi_loss, has_aux=True)(w["actor"])
+            ua, opt_a = self.opt.update(ga, opt_state["actor"], w["actor"])
+            actor_p = optax.apply_updates(w["actor"], ua)
+
+            polyak = lambda t, s: jax.tree_util.tree_map(
+                lambda a_, b_: (1 - tau) * a_ + tau * b_, t, s)
+            new_w = {"actor": actor_p, "q1": q1p, "q2": q2p,
+                     "q1_target": polyak(w["q1_target"], q1p),
+                     "q2_target": polyak(w["q2_target"], q2p),
+                     "value": value_p,
+                     "log_alpha": w["log_alpha"]}  # unused; kept for module
+            new_opt = {"actor": opt_a, "q1": opt_q1, "q2": opt_q2,
+                       "value": opt_v}
+            metrics = {"value_loss": lv, "critic_loss": 0.5 * (l1 + l2),
+                       "actor_loss": la, "adv_mean": jnp.mean(adv),
+                       "awr_weight_mean": jnp.mean(awr_w),
+                       "behavior_logp": jnp.mean(logp)}
+            return new_w, new_opt, metrics
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    def training_step(self) -> Dict:
+        return offline_training_step(
+            self, lambda mb, i: self._update(self.weights, self.opt_state, mb))
+
+    def evaluate(self) -> Dict:
+        return evaluate_continuous(self)
+
+    def get_weights(self):
+        return jax.device_get(self.weights)
+
+    def set_weights(self, weights):
+        self.weights = weights

@@ -1,0 +1,99 @@
+"""Shared helpers for the offline continuous-control algorithms (CQL, IQL).
+
+Both load a (obs, actions, rewards, next_obs, terminateds) dataset, infer a
+continuous ModuleSpec + action bounds from it, and evaluate by rolling the
+squashed-gaussian actor's mode in a real env — factored here so the logic
+can't drift between them."""
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+from .. import sample_batch as SB
+from ..offline import as_sample_batch
+from ..rl_module import ModuleSpec
+
+
+def load_continuous_dataset(config) -> Tuple[Dict[str, np.ndarray], int,
+                                             ModuleSpec, float, float]:
+    """Returns (data, n_rows, spec, action_low, action_high)."""
+    batch = as_sample_batch(config.offline_data)
+    data = {k: np.asarray(batch[k]) for k in
+            (SB.OBS, SB.ACTIONS, SB.REWARDS, SB.NEXT_OBS, SB.TERMINATEDS)}
+    acts = data[SB.ACTIONS]
+    if acts.ndim == 1:
+        acts = acts[:, None]
+        data[SB.ACTIONS] = acts
+    obs_shape = data[SB.OBS].shape[1:]
+    low = (config.action_low if config.action_low is not None
+           else float(acts.min()))
+    high = (config.action_high if config.action_high is not None
+            else float(acts.max()))
+    spec = ModuleSpec(obs_shape, "continuous", acts.shape[-1],
+                      tuple(config.model.get("hiddens", (256, 256))))
+    return data, len(data[SB.OBS]), spec, low, high
+
+
+def make_offline_optimizer(config, weights, net_keys):
+    """One optax optimizer shared by the per-net opt_states (CQL: q nets +
+    actor + alpha; IQL: q nets + actor + value). Returns (opt, schedule_fn,
+    opt_state)."""
+    from ray_tpu.ops.optim import make_optimizer
+    opt, sched = make_optimizer(
+        lr=config.lr, lr_schedule=getattr(config, "lr_schedule", None),
+        optimizer=getattr(config, "optimizer", "adam"),
+        grad_clip=getattr(config, "grad_clip", None))
+    return opt, sched, {k: opt.init(weights[k]) for k in net_keys}
+
+
+def offline_training_step(algo, step_once) -> Dict:
+    """Shared minibatch SGD loop: `step_once(minibatch, update_index)` runs
+    the algo's jitted update and returns (weights, opt_state, metrics).
+    cur_lr reports the lr of the LAST update applied (schedule evaluated at
+    the pre-increment count, same convention as JaxLearner)."""
+    import jax
+    cfg = algo.config
+    last = {}
+    lr_used = float(algo._lr_schedule(algo._updates))
+    for _ in range(cfg.train_intensity):
+        idx = algo._rng.integers(0, algo._n, size=cfg.train_batch_size)
+        mb = {k: v[idx] for k, v in algo._data.items()}
+        lr_used = float(algo._lr_schedule(algo._updates))
+        algo.weights, algo.opt_state, last = step_once(mb, algo._updates)
+        algo._updates += 1
+    learner = {k: float(v) for k, v in jax.device_get(last).items()}
+    learner["cur_lr"] = lr_used
+    return {"learner": learner, "num_env_steps_sampled_this_iter": 0}
+
+
+def evaluate_continuous(algo) -> Dict:
+    """Mode-policy rollout evaluation for SACModule-weight-layout algos."""
+    import jax
+    cfg = algo.config
+    if cfg.env is None:
+        return {}
+    import gymnasium as gym
+    env = gym.make(cfg.env) if isinstance(cfg.env, str) else cfg.env()
+    # compile once per algo instance, not per evaluate() call
+    infer = algo.__dict__.get("_eval_infer")
+    if infer is None:
+        infer = algo._eval_infer = jax.jit(algo.module.inference_step)
+    rets, lens = [], []
+    for ep in range(cfg.evaluation_duration):
+        obs, _ = env.reset(seed=cfg.seed + 10_000 + ep)
+        ret, n, done = 0.0, 0, False
+        while not done:
+            a, _ = infer(algo.weights, obs[None].astype(np.float32))
+            a = np.clip(np.asarray(a)[0], algo.module.low, algo.module.high)
+            obs, r, term, trunc, _ = env.step(a)
+            ret += float(r)
+            n += 1
+            done = term or trunc
+        rets.append(ret)
+        lens.append(n)
+    env.close()
+    return {"episodes_this_iter": len(rets),
+            "episode_return_mean": float(np.mean(rets)),
+            "episode_return_max": float(np.max(rets)),
+            "episode_return_min": float(np.min(rets)),
+            "episode_len_mean": float(np.mean(lens))}

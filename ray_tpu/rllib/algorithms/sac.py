@@ -104,6 +104,17 @@ class SACConfig(AlgorithmConfig):
 
 
 class SAC(Algorithm):
+    # subclass hooks (TQC): everything else in setup is shared
+    def _make_module(self, spec, low, high):
+        return SACModule(spec, low, high)
+
+    def _init_opt_state(self):
+        return {
+            "actor": self.opt.init(self.weights["actor"]),
+            "q1": self.opt.init(self.weights["q1"]),
+            "q2": self.opt.init(self.weights["q2"]),
+            "alpha": self.opt.init(self.weights["log_alpha"])}
+
     def setup(self, config: SACConfig):
         import gymnasium as gym
         from ..env_runner import EnvRunner
@@ -113,17 +124,13 @@ class SAC(Algorithm):
         low = float(np.min(space.low))
         high = float(np.max(space.high))
         probe.close()
-        self.module = SACModule(spec, low, high)
+        self.module = self._make_module(spec, low, high)
         self._setup_runners()
         key = jax.random.PRNGKey(config.seed)
         self.weights = self.module.init(key)
         import optax
         self.opt = optax.adam(config.lr)
-        self.opt_state = {
-            "actor": self.opt.init(self.weights["actor"]),
-            "q1": self.opt.init(self.weights["q1"]),
-            "q2": self.opt.init(self.weights["q2"]),
-            "alpha": self.opt.init(self.weights["log_alpha"])}
+        self.opt_state = self._init_opt_state()
         self.buffer = ReplayBuffer(config.replay_buffer_capacity,
                                    seed=config.seed)
         self.env_steps = 0

@@ -1,0 +1,189 @@
+"""TQC — Truncated Quantile Critics (reference: rllib/algorithms/tqc/tqc.py,
+tqc_learner.py; paper arXiv:2005.04269).
+
+SAC with distributional critics: an ensemble of `n_critics` nets each emits
+`n_quantiles` atoms of the return distribution Z(s,a). The Bellman target
+pools ALL target-net atoms at (s', a'~pi), sorts them, and DROPS the top
+`top_quantiles_to_drop_per_net * n_critics` — truncating the right tail is
+what controls overestimation (the ensemble-min trick of SAC, made granular).
+Critics fit the kept atoms by quantile Huber regression; the actor maximizes
+the mean over all atoms minus the entropy bonus; temperature auto-tunes as
+in SAC.
+
+tpu-first: the critic ensemble is a stacked-parameter vmap (one XLA program
+evaluates all n_critics nets as a single batched matmul stack feeding the
+MXU), and actor+critics+alpha+polyak live in ONE jitted update. Contrast:
+the reference's torch learner (tqc_learner.py) loops the ensemble in python.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from ray_tpu.models.torsos import MLPTorso
+from .. import sample_batch as SB
+from ..distributions import SquashedGaussian
+from ..rl_module import ModuleSpec
+from .sac import SAC, SACConfig, SACModule
+
+
+class _QuantileCritic(nn.Module):
+    spec: ModuleSpec
+    n_quantiles: int
+
+    @nn.compact
+    def __call__(self, obs, action):
+        x = jnp.concatenate([obs.reshape(obs.shape[0], -1), action], -1)
+        z = MLPTorso(self.spec.hiddens)(x)
+        return nn.Dense(self.n_quantiles, name="z")(z)   # [B, M]
+
+
+class TQCModule(SACModule):
+    """SAC acting surface + stacked quantile-critic ensemble."""
+
+    def __init__(self, spec: ModuleSpec, low: float, high: float,
+                 n_quantiles: int = 25, n_critics: int = 2):
+        super().__init__(spec, low, high)
+        self.n_quantiles = n_quantiles
+        self.n_critics = n_critics
+        self.qcritic = _QuantileCritic(spec, n_quantiles)
+
+    def init(self, key):
+        k_actor, k_crit = jax.random.split(key)
+        obs = jnp.zeros((1,) + self.spec.obs_shape, jnp.float32)
+        act = jnp.zeros((1, self.spec.action_dim), jnp.float32)
+        actor = self.actor.init(k_actor, obs)
+        # stacked ensemble params: leaf shape [n_critics, ...] so one vmapped
+        # apply evaluates every net in a single program
+        crit_keys = jax.random.split(k_crit, self.n_critics)
+        stack = jax.vmap(lambda k: self.qcritic.init(k, obs, act))(crit_keys)
+        copy = lambda t: jax.tree_util.tree_map(jnp.copy, t)
+        return {"actor": actor, "critics": stack,
+                "critics_target": copy(stack),
+                "log_alpha": jnp.asarray(0.0)}
+
+    def z_all(self, critics_params, obs, action):
+        """All atoms from all critics: [B, n_critics, n_quantiles]."""
+        z = jax.vmap(lambda p: self.qcritic.apply(p, obs, action))(
+            critics_params)                      # [n_critics, B, M]
+        return jnp.transpose(z, (1, 0, 2))
+
+
+class TQCConfig(SACConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = TQC
+        self.n_quantiles = 25                    # ref tqc.py:52
+        self.n_critics = 2
+        self.top_quantiles_to_drop_per_net = 2
+
+
+class TQC(SAC):
+    """Subclasses SAC: setup, the replay/rollout loop (training_step) and the
+    weight surface are inherited; only module construction, the opt_state
+    layout, and the jitted update differ."""
+
+    def _make_module(self, spec, low, high):
+        cfg = self.config
+        return TQCModule(spec, low, high, cfg.n_quantiles, cfg.n_critics)
+
+    def _init_opt_state(self):
+        return {
+            "actor": self.opt.init(self.weights["actor"]),
+            "critics": self.opt.init(self.weights["critics"]),
+            "alpha": self.opt.init(self.weights["log_alpha"])}
+
+    def _make_runner_kwargs(self):
+        kw = super()._make_runner_kwargs()
+        kw["module"] = TQCModule(self.module.spec, self.module.low,
+                                 self.module.high, self.module.n_quantiles,
+                                 self.module.n_critics)
+        kw["record_next_obs"] = True
+        return kw
+
+    def _build_update(self):
+        cfg = self.config
+        mod = self.module
+        gamma, tau = cfg.gamma, cfg.tau
+        target_entropy = self.target_entropy
+        m = cfg.n_quantiles
+        n_crit = cfg.n_critics
+        n_keep = n_crit * m - n_crit * cfg.top_quantiles_to_drop_per_net
+        if n_keep <= 0:
+            raise ValueError("top_quantiles_to_drop_per_net drops every atom")
+        # quantile midpoints tau_i = (2i+1)/2M — one per predicted atom
+        taus = (jnp.arange(m, dtype=jnp.float32) + 0.5) / m
+
+        def quantile_huber(pred, target):
+            """pred [B, M] vs target [B, K] → scalar (kappa=1 Huber)."""
+            delta = target[:, None, :] - pred[:, :, None]     # [B, M, K]
+            a = jnp.abs(delta)
+            huber = jnp.where(a <= 1.0, 0.5 * delta * delta, a - 0.5)
+            wgt = jnp.abs(taus[None, :, None] -
+                          (delta < 0).astype(jnp.float32))
+            return jnp.mean(jnp.sum(jnp.mean(wgt * huber, axis=2), axis=1))
+
+        def update(w, opt_state, batch, key):
+            import optax
+            obs, act = batch[SB.OBS], batch[SB.ACTIONS]
+            nxt, rew = batch[SB.NEXT_OBS], batch[SB.REWARDS]
+            done = batch[SB.TERMINATEDS]
+            alpha = jnp.exp(w["log_alpha"])
+            k1, k2 = jax.random.split(key)
+
+            # -- truncated distributional target
+            dist_n, _ = mod._dist(w, nxt)
+            a_n, logp_n = dist_n.sample_and_log_prob(k1)
+            z_n = mod.z_all(w["critics_target"], nxt, a_n)   # [B, C, M]
+            z_pool = jnp.sort(z_n.reshape(z_n.shape[0], -1), axis=-1)
+            z_keep = z_pool[:, :n_keep]                      # drop top tail
+            target = rew[:, None] + gamma * (1 - done)[:, None] * (
+                z_keep - alpha * logp_n[:, None])
+            target = jax.lax.stop_gradient(target)           # [B, K]
+
+            def z_loss(cp):
+                z = mod.z_all(cp, obs, act)                  # [B, C, M]
+                per = jax.vmap(quantile_huber, in_axes=(1, None))(z, target)
+                return jnp.sum(per)
+
+            lz, gz = jax.value_and_grad(z_loss)(w["critics"])
+            uz, opt_c = self.opt.update(gz, opt_state["critics"],
+                                        w["critics"])
+            critics_p = optax.apply_updates(w["critics"], uz)
+
+            # -- actor: mean of ALL atoms (no truncation on the policy side)
+            def pi_loss(ap):
+                mean, log_std = mod.actor.apply(ap, obs)
+                dist = SquashedGaussian(mean, log_std, mod.low, mod.high)
+                a, logp = dist.sample_and_log_prob(k2)
+                q = jnp.mean(mod.z_all(critics_p, obs, a), axis=(1, 2))
+                return jnp.mean(alpha * logp - q), logp
+
+            (la, logp), ga = jax.value_and_grad(
+                pi_loss, has_aux=True)(w["actor"])
+            ua, opt_a = self.opt.update(ga, opt_state["actor"], w["actor"])
+            actor_p = optax.apply_updates(w["actor"], ua)
+
+            def alpha_loss(log_alpha):
+                return -jnp.mean(jnp.exp(log_alpha) *
+                                 jax.lax.stop_gradient(logp + target_entropy))
+
+            lt, gt = jax.value_and_grad(alpha_loss)(w["log_alpha"])
+            ut, opt_t = self.opt.update(gt, opt_state["alpha"], w["log_alpha"])
+            log_alpha = optax.apply_updates(w["log_alpha"], ut)
+
+            polyak = lambda t, s: jax.tree_util.tree_map(
+                lambda a_, b_: (1 - tau) * a_ + tau * b_, t, s)
+            new_w = {"actor": actor_p, "critics": critics_p,
+                     "critics_target": polyak(w["critics_target"], critics_p),
+                     "log_alpha": log_alpha}
+            new_opt = {"actor": opt_a, "critics": opt_c, "alpha": opt_t}
+            metrics = {"critic_loss": lz / n_crit, "actor_loss": la,
+                       "alpha": jnp.exp(log_alpha),
+                       "entropy": -jnp.mean(logp),
+                       "z_target_mean": jnp.mean(z_keep)}
+            return new_w, new_opt, metrics
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    # training_step / get_weights / set_weights inherited from SAC
