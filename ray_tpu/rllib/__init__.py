@@ -7,9 +7,9 @@ update as one jitted program on the TPU.
 
 from .algorithm import Algorithm, AlgorithmConfig
 from .algorithms import (APPO, APPOConfig, BC, BCConfig, CQL, CQLConfig, DQN,
-                         DQNConfig, IMPALA, IMPALAConfig, IQL, IQLConfig,
-                         MARWIL, MARWILConfig, PPO, PPOConfig, SAC, SACConfig,
-                         TQC, TQCConfig)
+                         DQNConfig, DreamerV3, DreamerV3Config, IMPALA,
+                         IMPALAConfig, IQL, IQLConfig, MARWIL, MARWILConfig,
+                         PPO, PPOConfig, SAC, SACConfig, TQC, TQCConfig)
 from .buffers import PrioritizedReplayBuffer, ReplayBuffer
 from .env_runner import EnvRunner
 from .learner import JaxLearner, LearnerGroup, make_learner_group
@@ -23,5 +23,5 @@ __all__ = [
     "PPO", "PPOConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
     "IMPALA", "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
     "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "IQL", "IQLConfig",
-    "TQC", "TQCConfig",
+    "TQC", "TQCConfig", "DreamerV3", "DreamerV3Config",
 ]

@@ -1,0 +1,658 @@
+"""DreamerV3 — model-based RL (reference: rllib/algorithms/dreamerv3/
+dreamerv3.py, dreamerv3_learner.py, dreamerv3_rl_module.py; paper
+arXiv:2301.04104).
+
+Learns a Recurrent State-Space Model (RSSM) world model from replayed
+sequences, then trains actor+critic entirely inside imagined rollouts:
+- RSSM: deterministic GRU path h_t, discrete stochastic latent z_t
+  (stoch x classes categorical with straight-through gradients and 1%
+  uniform mixing), posterior q(z|h,embed) vs prior p(z|h) with
+  KL-balancing (dyn 0.5 / rep 0.1) and free bits,
+- symlog-MSE observation reconstruction, twohot-symlog reward head,
+  Bernoulli continue head,
+- imagination: H-step rollout under the actor from every posterior state,
+  lambda-returns, percentile-normalized REINFORCE actor loss + entropy,
+  twohot critic with an EMA slow-critic regularizer.
+
+tpu-first: the observe pass, the imagination rollout, and the backward
+lambda-return recursion are all `lax.scan`s inside ONE jitted update — no
+python loops over time; the reference's torch learner steps the GRU in a
+python for-loop (dreamerv3/torch/models/sequence_model.py).
+
+Env interaction is an inline recurrent loop (the actor carries (h, z)
+across env steps), so this algorithm opts out of the generic stateless
+EnvRunner fleet the same way CQL does.
+"""
+
+from typing import Dict
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..algorithm import Algorithm, AlgorithmConfig
+
+
+# ----------------------------------------------------------- symlog / twohot
+def symlog(x):
+    return jnp.sign(x) * jnp.log1p(jnp.abs(x))
+
+
+def symexp(x):
+    return jnp.sign(x) * (jnp.exp(jnp.abs(x)) - 1.0)
+
+
+def twohot(x, bins):
+    """Encode scalars as weight over the two nearest bins. x: [...], bins
+    [K] ascending → [..., K]."""
+    k = bins.shape[0]
+    idx = jnp.sum((bins[None, :] <= x[..., None]).astype(jnp.int32), -1) - 1
+    idx = jnp.clip(idx, 0, k - 2)
+    lo, hi = bins[idx], bins[idx + 1]
+    w_hi = jnp.clip((x - lo) / jnp.maximum(hi - lo, 1e-8), 0.0, 1.0)
+    oh_lo = jax.nn.one_hot(idx, k) * (1.0 - w_hi)[..., None]
+    oh_hi = jax.nn.one_hot(idx + 1, k) * w_hi[..., None]
+    return oh_lo + oh_hi
+
+
+def _bins(k=255, lo=-20.0, hi=20.0):
+    return jnp.linspace(lo, hi, k)
+
+
+# ------------------------------------------------------------------- modules
+class _MLP(nn.Module):
+    sizes: tuple
+    out: int
+
+    @nn.compact
+    def __call__(self, x):
+        for s in self.sizes:
+            x = nn.silu(nn.LayerNorm()(nn.Dense(s)(x)))
+        return nn.Dense(self.out)(x)
+
+
+class _WorldModel(nn.Module):
+    """Encoder + RSSM + decoder/reward/continue heads for vector obs."""
+    obs_dim: int
+    action_dim: int
+    deter: int
+    stoch: int
+    classes: int
+    hiddens: tuple
+    reward_bins: int = 255
+
+    def setup(self):
+        z_dim = self.stoch * self.classes
+        self.encoder = _MLP(self.hiddens, self.hiddens[-1])
+        self.gru = nn.GRUCell(features=self.deter)
+        self.img_in = _MLP((self.hiddens[-1],), self.hiddens[-1])
+        self.prior_net = _MLP((self.hiddens[-1],), z_dim)
+        self.post_net = _MLP((self.hiddens[-1],), z_dim)
+        self.decoder = _MLP(self.hiddens, self.obs_dim)
+        self.reward_head = _MLP(self.hiddens, self.reward_bins)
+        self.cont_head = _MLP(self.hiddens, 1)
+
+    def __call__(self, obs, a_prev, is_first):
+        """Init-only path: touches every submodule so one init() creates all
+        params. obs [B,T,obs], a_prev [B,T,A], is_first [B,T]."""
+        embed = self.embed(obs)
+        b = obs.shape[0]
+        h = jnp.zeros((b, self.deter))
+        z = jnp.zeros((b, self.stoch * self.classes))
+        key = self.make_rng("sample")
+        h, z, _, _ = self.obs_step(h, z, a_prev[:, 0], embed[:, 0],
+                                   is_first[:, 0], key)
+        return self.heads(self.feat(h, z))
+
+    # -- latent utilities
+    def _logits(self, raw):
+        lg = raw.reshape(raw.shape[:-1] + (self.stoch, self.classes))
+        # 1% uniform mixing keeps KL finite and gradients alive
+        probs = 0.99 * jax.nn.softmax(lg, -1) + 0.01 / self.classes
+        return jnp.log(probs)
+
+    def _sample(self, logits, key):
+        idx = jax.random.categorical(key, logits)
+        oh = jax.nn.one_hot(idx, self.classes)
+        probs = jax.nn.softmax(logits, -1)
+        st = oh + probs - jax.lax.stop_gradient(probs)   # straight-through
+        return st.reshape(st.shape[:-2] + (self.stoch * self.classes,))
+
+    def feat(self, h, z):
+        return jnp.concatenate([h, z], -1)
+
+    # -- one posterior (observe) step: carry (h, z_prev) over time
+    def obs_step(self, h, z_prev, a_prev, embed, is_first, key):
+        h = jnp.where(is_first[..., None], 0.0, h)
+        z_prev = jnp.where(is_first[..., None], 0.0, z_prev)
+        a_prev = jnp.where(is_first[..., None], 0.0, a_prev)
+        x = self.img_in(jnp.concatenate([z_prev, a_prev], -1))
+        h = self.gru(h, x)[1]
+        prior_logits = self._logits(self.prior_net(h))
+        post_logits = self._logits(
+            self.post_net(jnp.concatenate([h, embed], -1)))
+        z = self._sample(post_logits, key)
+        return h, z, prior_logits, post_logits
+
+    # -- one prior (imagine) step
+    def img_step(self, h, z, a, key):
+        x = self.img_in(jnp.concatenate([z, a], -1))
+        h = self.gru(h, x)[1]
+        prior_logits = self._logits(self.prior_net(h))
+        z = self._sample(prior_logits, key)
+        return h, z
+
+    def embed(self, obs):
+        return self.encoder(symlog(obs))
+
+    def heads(self, feat):
+        recon = self.decoder(feat)
+        reward_logits = self.reward_head(feat)
+        cont_logit = self.cont_head(feat)[..., 0]
+        return recon, reward_logits, cont_logit
+
+    def reward(self, feat):
+        probs = jax.nn.softmax(self.reward_head(feat), -1)
+        return symexp(jnp.sum(probs * _bins(self.reward_bins), -1))
+
+    def cont(self, feat):
+        return jax.nn.sigmoid(self.cont_head(feat)[..., 0])
+
+
+class _Actor(nn.Module):
+    action_dim: int
+    discrete: bool
+    hiddens: tuple
+
+    @nn.compact
+    def __call__(self, feat):
+        out = self.action_dim if self.discrete else 2 * self.action_dim
+        return _MLP(self.hiddens, out)(feat)
+
+
+class _Critic(nn.Module):
+    hiddens: tuple
+    bins: int = 255
+
+    @nn.compact
+    def __call__(self, feat):
+        return _MLP(self.hiddens, self.bins)(feat)
+
+
+def _critic_value(logits, bins):
+    return symexp(jnp.sum(jax.nn.softmax(logits, -1) * bins, -1))
+
+
+# -------------------------------------------------------------------- config
+class DreamerV3Config(AlgorithmConfig):
+    def __init__(self):
+        super().__init__()
+        self.algo_class = DreamerV3
+        # model scale (reference model_size="XS" analog —
+        # dreamerv3.py `model_size` presets)
+        self.deter = 256
+        self.stoch = 8
+        self.classes = 8
+        self.model = {"hiddens": (256, 256)}
+        # world-model loss
+        self.free_nats = 1.0
+        self.kl_dyn_scale = 0.5
+        self.kl_rep_scale = 0.1
+        self.wm_lr = 1e-4
+        # actor-critic (imagination)
+        self.horizon = 15
+        self.gamma = 0.997
+        self.lambda_ = 0.95
+        self.ac_lr = 3e-5
+        self.entropy_scale = 3e-4
+        self.critic_ema_decay = 0.98
+        self.critic_ema_scale = 1.0
+        self.return_norm_decay = 0.99
+        # replay / schedule
+        self.batch_size_B = 8
+        self.batch_length_T = 24
+        self.replay_capacity = 50_000
+        self.rollout_fragment_length = 64   # env steps collected per iter
+        self.num_steps_sampled_before_learning_starts = 512
+        self.train_intensity = 1            # updates per training_step
+
+
+# ---------------------------------------------------------- sequence replay
+class _SequenceReplay:
+    """Flat transition store with is_first markers; samples [B, T] windows
+    uniformly (windows may span episode boundaries — obs_step resets on
+    is_first, same contract as the reference's episode replay)."""
+
+    def __init__(self, capacity, seed):
+        self.capacity = capacity
+        self._store = None
+        self._n = 0
+        self._ptr = 0
+        self._rng = np.random.default_rng(seed)
+
+    def add(self, rows: Dict[str, np.ndarray]):
+        m = len(next(iter(rows.values())))
+        if self._store is None:
+            self._store = {k: np.zeros((self.capacity,) + v.shape[1:],
+                                       v.dtype) for k, v in rows.items()}
+        for k, v in rows.items():
+            idx = (self._ptr + np.arange(m)) % self.capacity
+            self._store[k][idx] = v
+        self._ptr = (self._ptr + m) % self.capacity
+        self._n = min(self._n + m, self.capacity)
+
+    def __len__(self):
+        return self._n
+
+    def sample(self, b, t):
+        # sample in LOGICAL (time) order so no window straddles the ring's
+        # write seam: logical 0 is the oldest row (raw _ptr once wrapped)
+        base = self._ptr if self._n == self.capacity else 0
+        starts = self._rng.integers(0, self._n - t, size=b)
+        idx = (base + starts[:, None] + np.arange(t)[None, :]) % self.capacity
+        return {k: v[idx] for k, v in self._store.items()}
+
+
+# ----------------------------------------------------------------- algorithm
+class DreamerV3(Algorithm):
+    _supports_eval_actors = False
+
+    def setup(self, config: DreamerV3Config):
+        import gymnasium as gym
+        env = (gym.make(config.env) if isinstance(config.env, str)
+               else config.env())
+        self._env = env
+        obs_space = env.observation_space
+        act_space = env.action_space
+        self._discrete = hasattr(act_space, "n")
+        obs_dim = int(np.prod(obs_space.shape))
+        action_dim = (int(act_space.n) if self._discrete
+                      else int(np.prod(act_space.shape)))
+        if not self._discrete:
+            self._act_low = np.asarray(act_space.low, np.float32)
+            self._act_high = np.asarray(act_space.high, np.float32)
+        hiddens = tuple(config.model.get("hiddens", (256, 256)))
+        self.wm = _WorldModel(obs_dim, action_dim, config.deter,
+                              config.stoch, config.classes, hiddens)
+        self.actor = _Actor(action_dim, self._discrete, hiddens)
+        self.critic = _Critic(hiddens)
+
+        key = jax.random.PRNGKey(config.seed)
+        k_wm, k_a, k_c, self._act_key = jax.random.split(key, 4)
+        z_dim = config.stoch * config.classes
+        feat0 = jnp.zeros((1, config.deter + z_dim))
+        obs0 = jnp.zeros((1, 1, obs_dim))
+        a0 = jnp.zeros((1, 1, action_dim))
+        first0 = jnp.ones((1, 1))
+        self.weights = {
+            "wm": self.wm.init({"params": k_wm, "sample": k_wm},
+                               obs0, a0, first0),
+            "actor": self.actor.init(k_a, feat0),
+            "critic": self.critic.init(k_c, feat0),
+        }
+        self.weights["critic_ema"] = jax.tree_util.tree_map(
+            jnp.copy, self.weights["critic"])
+        import optax
+        self.wm_opt = optax.chain(optax.clip_by_global_norm(1000.0),
+                                  optax.adam(config.wm_lr))
+        self.ac_opt = optax.chain(optax.clip_by_global_norm(100.0),
+                                  optax.adam(config.ac_lr))
+        self.opt_state = {
+            "wm": self.wm_opt.init(self.weights["wm"]),
+            "actor": self.ac_opt.init(self.weights["actor"]),
+            "critic": self.ac_opt.init(self.weights["critic"])}
+        # return-normalization EMA of (p95 - p5)
+        self.ret_scale = jnp.asarray(1.0)
+        self.replay = _SequenceReplay(config.replay_capacity, config.seed)
+        self.env_steps = 0
+        self._updates = 0
+        # recurrent acting state
+        self._h = np.zeros(config.deter, np.float32)
+        self._z = np.zeros(z_dim, np.float32)
+        self._a_prev = np.zeros(action_dim, np.float32)
+        self._obs, _ = env.reset(seed=config.seed)
+        self._is_first = True
+        self._r_arrival = 0.0
+        self._ep_ret = 0.0
+        self._ep_len = 0
+        self._ep_returns = []
+        self._ep_lens = []
+        self._build_fns()
+
+    # ------------------------------------------------------------- jit: act
+    def _build_fns(self):
+        cfg = self.config
+        wm, actor, critic = self.wm, self.actor, self.critic
+        discrete = self._discrete
+        bins = _bins()
+
+        def act(w, h, z, a_prev, obs, is_first, key):
+            k_post, k_act = jax.random.split(key)
+            embed = wm.apply(w["wm"], obs[None], method=_WorldModel.embed)
+            h, z, _, _ = wm.apply(
+                w["wm"], h[None], z[None], a_prev[None], embed,
+                jnp.asarray([is_first], jnp.float32), k_post,
+                method=_WorldModel.obs_step)
+            feat = jnp.concatenate([h, z], -1)
+            out = actor.apply(w["actor"], feat)
+            if discrete:
+                a_idx = jax.random.categorical(k_act, out[0])
+                a = jax.nn.one_hot(a_idx, out.shape[-1])
+            else:
+                d = out.shape[-1] // 2
+                mean, log_std = out[0, :d], jnp.clip(out[0, d:], -5, 2)
+                a = jnp.tanh(mean + jnp.exp(log_std) *
+                             jax.random.normal(k_act, (d,)))
+            return h[0], z[0], a
+
+        self._act = jax.jit(act)
+
+        # --------------------------------------------------------- jit: update
+        B, T, H = cfg.batch_size_B, cfg.batch_length_T, cfg.horizon
+        gamma, lam = cfg.gamma, cfg.lambda_
+
+        def wm_loss(wp, batch, key):
+            obs, act_seq = batch["obs"], batch["action"]
+            rew, cont = batch["reward"], 1.0 - batch["is_terminated"]
+            is_first = batch["is_first"]
+            embed = wm.apply(wp, obs, method=_WorldModel.embed)  # [B,T,E]
+            z_dim = cfg.stoch * cfg.classes
+            h0 = jnp.zeros((B, cfg.deter))
+            z0 = jnp.zeros((B, z_dim))
+            # previous action at step t is act[t-1] (zero at t=0)
+            a_prev = jnp.concatenate(
+                [jnp.zeros_like(act_seq[:, :1]), act_seq[:, :-1]], 1)
+            keys = jax.random.split(key, T)
+
+            def step(carry, xs):
+                h, z = carry
+                a_p, emb, first, k = xs
+                h, z, prior_lg, post_lg = wm.apply(
+                    wp, h, z, a_p, emb, first, k,
+                    method=_WorldModel.obs_step)
+                return (h, z), (h, z, prior_lg, post_lg)
+
+            xs = (jnp.moveaxis(a_prev, 0, 1), jnp.moveaxis(embed, 0, 1),
+                  jnp.moveaxis(is_first, 0, 1), keys)
+            _, (hs, zs, prior_lg, post_lg) = jax.lax.scan(
+                step, (h0, z0), xs)
+            hs = jnp.moveaxis(hs, 0, 1)          # [B,T,deter]
+            zs = jnp.moveaxis(zs, 0, 1)
+            prior_lg = jnp.moveaxis(prior_lg, 0, 1)
+            post_lg = jnp.moveaxis(post_lg, 0, 1)
+            feat = jnp.concatenate([hs, zs], -1)
+            recon, rlogits, clogit = wm.apply(wp, feat,
+                                              method=_WorldModel.heads)
+            recon_loss = jnp.mean(
+                jnp.sum(jnp.square(recon - symlog(obs)), -1))
+            rtarget = twohot(symlog(rew), bins)
+            reward_loss = -jnp.mean(jnp.sum(
+                rtarget * jax.nn.log_softmax(rlogits, -1), -1))
+            cont_loss = jnp.mean(
+                jnp.maximum(clogit, 0) - clogit * cont +
+                jnp.log1p(jnp.exp(-jnp.abs(clogit))))
+
+            def kl(p_lg, q_lg):
+                # KL(post||prior) per latent, summed over stoch dims
+                return jnp.sum(jnp.sum(
+                    jnp.exp(p_lg) * (p_lg - q_lg), -1), -1)
+
+            dyn = jnp.maximum(cfg.free_nats,
+                              jnp.mean(kl(jax.lax.stop_gradient(post_lg),
+                                          prior_lg)))
+            rep = jnp.maximum(cfg.free_nats,
+                              jnp.mean(kl(post_lg,
+                                          jax.lax.stop_gradient(prior_lg))))
+            loss = (recon_loss + reward_loss + cont_loss +
+                    cfg.kl_dyn_scale * dyn + cfg.kl_rep_scale * rep)
+            metrics = {"wm_recon": recon_loss, "wm_reward": reward_loss,
+                       "wm_cont": cont_loss, "wm_kl_dyn": dyn,
+                       "wm_kl_rep": rep}
+            return loss, (hs, zs, metrics)
+
+        def actor_dist(ap, feat, key):
+            out = actor.apply(ap, feat)
+            if discrete:
+                logp_all = jax.nn.log_softmax(out, -1)
+                a_idx = jax.random.categorical(key, out)
+                a = jax.nn.one_hot(a_idx, out.shape[-1])
+                logp = jnp.sum(a * logp_all, -1)
+                ent = -jnp.sum(jnp.exp(logp_all) * logp_all, -1)
+            else:
+                d = out.shape[-1] // 2
+                mean, log_std = out[..., :d], jnp.clip(out[..., d:], -5, 2)
+                eps = jax.random.normal(key, mean.shape)
+                pre = mean + jnp.exp(log_std) * eps
+                a = jnp.tanh(pre)
+                base = (-0.5 * jnp.square(eps) - log_std -
+                        0.5 * jnp.log(2 * jnp.pi))
+                logp = jnp.sum(base - jnp.log1p(-jnp.square(a) + 1e-6), -1)
+                ent = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), -1)
+            return a, logp, ent
+
+        def actor_logp_ent(ap, feat, a):
+            """Log-prob of GIVEN actions under the actor at feat — the
+            REINFORCE estimator needs the rollout's own actions, not a fresh
+            sample (a fresh sample's score is independent of the advantage
+            and its expected gradient is zero)."""
+            out = actor.apply(ap, feat)
+            if discrete:
+                logp_all = jax.nn.log_softmax(out, -1)
+                logp = jnp.sum(a * logp_all, -1)
+                ent = -jnp.sum(jnp.exp(logp_all) * logp_all, -1)
+            else:
+                d = out.shape[-1] // 2
+                mean, log_std = out[..., :d], jnp.clip(out[..., d:], -5, 2)
+                a_c = jnp.clip(a, -1 + 1e-6, 1 - 1e-6)
+                pre = jnp.arctanh(a_c)
+                base = (-0.5 * jnp.square((pre - mean) / jnp.exp(log_std))
+                        - log_std - 0.5 * jnp.log(2 * jnp.pi))
+                logp = jnp.sum(base - jnp.log1p(-jnp.square(a_c) + 1e-6), -1)
+                ent = jnp.sum(log_std + 0.5 * jnp.log(2 * jnp.pi * jnp.e), -1)
+            return logp, ent
+
+        def update(w, opt_state, ret_scale, batch, key):
+            import optax
+            k_wm, k_img = jax.random.split(key)
+            (wl, (hs, zs, wm_metrics)), gw = jax.value_and_grad(
+                wm_loss, has_aux=True)(w["wm"], batch, k_wm)
+            uw, opt_wm = self.wm_opt.update(gw, opt_state["wm"], w["wm"])
+            wm_p = optax.apply_updates(w["wm"], uw)
+
+            # ---- imagination from every posterior state
+            start_h = jax.lax.stop_gradient(hs.reshape(B * T, -1))
+            start_z = jax.lax.stop_gradient(zs.reshape(B * T, -1))
+
+            def img(carry, k):
+                h, z = carry
+                k1, k2 = jax.random.split(k)
+                feat = jnp.concatenate([h, z], -1)
+                a, logp, ent = actor_dist(w["actor"], feat, k1)
+                h2, z2 = wm.apply(wm_p, h, z, a, k2,
+                                  method=_WorldModel.img_step)
+                return (h2, z2), (feat, a, logp, ent, h2, z2)
+
+            keys = jax.random.split(k_img, H)
+            _, (feats, acts, _logps, ents, hs_i, zs_i) = jax.lax.scan(
+                img, (start_h, start_z), keys)
+            # feats[t] is the state the action at t was taken FROM
+            last_feat = jnp.concatenate([hs_i[-1], zs_i[-1]], -1)
+            all_feats = jnp.concatenate([feats, last_feat[None]], 0)  # [H+1,N,F]
+            rewards = wm.apply(wm_p, all_feats[1:],
+                               method=_WorldModel.reward)        # r after act
+            conts = wm.apply(wm_p, all_feats[1:],
+                             method=_WorldModel.cont)
+            v_logits = critic.apply(w["critic"], all_feats)
+            values = _critic_value(v_logits, bins)                # [H+1,N]
+            disc = gamma * conts
+
+            def lam_ret(carry, xs):
+                r, d, v_next = xs
+                ret = r + d * ((1 - lam) * v_next + lam * carry)
+                return ret, ret
+
+            _, rets = jax.lax.scan(
+                lam_ret, values[-1],
+                (rewards[::-1], disc[::-1], values[1:][::-1]))
+            rets = rets[::-1]                                     # [H,N]
+
+            # ---- actor (REINFORCE on normalized advantage)
+            flat_rets = rets.reshape(-1)
+            p95 = jnp.percentile(flat_rets, 95)
+            p5 = jnp.percentile(flat_rets, 5)
+            new_scale = (cfg.return_norm_decay * ret_scale +
+                         (1 - cfg.return_norm_decay) * (p95 - p5))
+            denom = jnp.maximum(1.0, new_scale)
+            # weight imagined steps by survival probability
+            live = jnp.concatenate(
+                [jnp.ones_like(conts[:1]),
+                 jnp.cumprod(conts[:-1], 0)], 0)
+            adv = jax.lax.stop_gradient((rets - values[:-1]) / denom)
+
+            def actor_loss(ap):
+                logp, ent = actor_logp_ent(
+                    ap, jax.lax.stop_gradient(feats),
+                    jax.lax.stop_gradient(acts))
+                return -jnp.mean(live * (logp * adv +
+                                         cfg.entropy_scale * ent))
+
+            la, ga = jax.value_and_grad(actor_loss)(w["actor"])
+            ua, opt_a = self.ac_opt.update(ga, opt_state["actor"],
+                                           w["actor"])
+            actor_p = optax.apply_updates(w["actor"], ua)
+
+            # ---- critic (twohot CE to lambda returns + EMA regularizer)
+            tgt = jax.lax.stop_gradient(twohot(symlog(rets), bins))
+            feats_sg = jax.lax.stop_gradient(feats)
+            ema_logits = critic.apply(w["critic_ema"], feats_sg)
+            ema_tgt = jax.lax.stop_gradient(jax.nn.softmax(ema_logits, -1))
+
+            def critic_loss(cp):
+                lg = critic.apply(cp, feats_sg)
+                logp = jax.nn.log_softmax(lg, -1)
+                ce = -jnp.sum(tgt * logp, -1)
+                reg = -jnp.sum(ema_tgt * logp, -1)
+                return jnp.mean(live * (ce + cfg.critic_ema_scale * reg))
+
+            lc, gc = jax.value_and_grad(critic_loss)(w["critic"])
+            uc, opt_c = self.ac_opt.update(gc, opt_state["critic"],
+                                           w["critic"])
+            critic_p = optax.apply_updates(w["critic"], uc)
+            ema_p = jax.tree_util.tree_map(
+                lambda e, c: cfg.critic_ema_decay * e +
+                (1 - cfg.critic_ema_decay) * c,
+                w["critic_ema"], critic_p)
+
+            new_w = {"wm": wm_p, "actor": actor_p, "critic": critic_p,
+                     "critic_ema": ema_p}
+            new_opt = {"wm": opt_wm, "actor": opt_a, "critic": opt_c}
+            metrics = dict(wm_metrics)
+            metrics.update({"wm_loss": wl, "actor_loss": la,
+                            "critic_loss": lc,
+                            "imagined_return": jnp.mean(rets),
+                            "return_scale": new_scale,
+                            "actor_entropy": jnp.mean(ents)})
+            return new_w, new_opt, new_scale, metrics
+
+        self._update = jax.jit(update, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------ collection
+    def _collect(self, n_steps):
+        """Arrival convention (matches the reference's episode replay): each
+        row is an OBSERVATION with the reward received on arriving at it, the
+        action chosen FROM it, and whether it is terminal. Terminal arrival
+        observations get their own row (zero action) — that is the only way
+        the continue head ever sees a terminal example."""
+        rows = {"obs": [], "action": [], "reward": [], "is_first": [],
+                "is_terminated": []}
+
+        def emit(obs, action, reward, is_first, is_terminal):
+            rows["obs"].append(obs)
+            rows["action"].append(action.astype(np.float32))
+            rows["reward"].append(np.float32(reward))
+            rows["is_first"].append(np.float32(is_first))
+            rows["is_terminated"].append(np.float32(is_terminal))
+
+        for _ in range(n_steps):
+            self._act_key, k = jax.random.split(self._act_key)
+            obs = np.asarray(self._obs, np.float32).reshape(-1)
+            h, z, a = self._act(self.weights, self._h, self._z,
+                                self._a_prev, obs,
+                                float(self._is_first), k)
+            self._h, self._z = np.asarray(h), np.asarray(z)
+            a = np.asarray(a)
+            if self._discrete:
+                env_a = int(np.argmax(a))
+            else:
+                # tanh output in [-1,1] → env bounds
+                env_a = (self._act_low + (a + 1) / 2 *
+                         (self._act_high - self._act_low))
+            nxt, r, term, trunc, _ = self._env.step(env_a)
+            emit(obs, a, self._r_arrival, self._is_first, False)
+            self._r_arrival = float(r)
+            self._ep_ret += float(r)
+            self._ep_len += 1
+            self._a_prev = a.astype(np.float32)
+            self._is_first = False
+            self._obs = nxt
+            if term or trunc:
+                # final arrival row: reward of the last action, terminal flag
+                # only for true termination (truncation may bootstrap)
+                emit(np.asarray(nxt, np.float32).reshape(-1),
+                     np.zeros_like(self._a_prev), r, False, term)
+                self._ep_returns.append(self._ep_ret)
+                self._ep_lens.append(self._ep_len)
+                self._ep_ret, self._ep_len = 0.0, 0
+                self._obs, _ = self._env.reset()
+                self._is_first = True
+                self._r_arrival = 0.0
+                # fresh buffers: np.asarray over a jax array is read-only
+                self._h = np.zeros_like(self._h)
+                self._z = np.zeros_like(self._z)
+                self._a_prev = np.zeros_like(self._a_prev)
+        self.env_steps += n_steps
+        self._env_steps_iter += n_steps   # base-class lifetime accounting
+        return {k: np.stack(v) for k, v in rows.items()}
+
+    # -------------------------------------------------------------- training
+    def training_step(self) -> Dict:
+        cfg = self.config
+        self.replay.add(self._collect(cfg.rollout_fragment_length))
+        metrics = {"num_env_steps_sampled_this_iter":
+                   cfg.rollout_fragment_length,
+                   "num_env_steps_sampled": self.env_steps}
+        if self._ep_returns:
+            metrics["episode_return_mean"] = float(
+                np.mean(self._ep_returns[-20:]))
+            metrics["episode_len_mean"] = float(
+                np.mean(self._ep_lens[-20:]))
+        if (self.env_steps < cfg.num_steps_sampled_before_learning_starts or
+                len(self.replay) < cfg.batch_length_T + 1):
+            return metrics
+        last = {}
+        for _ in range(cfg.train_intensity):
+            batch = self.replay.sample(cfg.batch_size_B, cfg.batch_length_T)
+            batch = {k: jnp.asarray(v) for k, v in batch.items()}
+            key = jax.random.PRNGKey(self.config.seed * 7919 + self._updates)
+            self.weights, self.opt_state, self.ret_scale, last = \
+                self._update(self.weights, self.opt_state, self.ret_scale,
+                             batch, key)
+            self._updates += 1
+        metrics["learner"] = {k: float(v) for k, v in
+                              jax.device_get(last).items()}
+        return metrics
+
+    def evaluate(self) -> Dict:
+        # the training env loop IS the policy rollout; report recent returns
+        if not self._ep_returns:
+            return {}
+        recent = self._ep_returns[-self.config.evaluation_duration:]
+        return {"episodes_this_iter": len(recent),
+                "episode_return_mean": float(np.mean(recent))}
+
+    def get_weights(self):
+        return jax.device_get(self.weights)
+
+    def set_weights(self, weights):
+        self.weights = weights

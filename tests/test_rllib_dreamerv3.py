@@ -1,0 +1,120 @@
+"""DreamerV3 (VERDICT r4 missing #5; ref: rllib/algorithms/dreamerv3/)."""
+
+import numpy as np
+import pytest
+
+
+def test_symlog_twohot_roundtrip():
+    import jax.numpy as jnp
+    from ray_tpu.rllib.algorithms.dreamerv3 import (_bins, symexp, symlog,
+                                                    twohot)
+    x = jnp.asarray([-100.0, -1.5, 0.0, 0.3, 7.0, 500.0])
+    np.testing.assert_allclose(symexp(symlog(x)), x, rtol=1e-5, atol=1e-5)
+    # twohot of symlog decodes back through the bin expectation
+    bins = _bins()
+    enc = twohot(symlog(x), bins)
+    np.testing.assert_allclose(np.sum(np.asarray(enc), -1), 1.0, atol=1e-5)
+    dec = symexp(jnp.sum(enc * bins, -1))
+    np.testing.assert_allclose(dec, x, rtol=2e-2, atol=1e-2)
+
+
+def test_sequence_replay_windows():
+    from ray_tpu.rllib.algorithms.dreamerv3 import _SequenceReplay
+    rep = _SequenceReplay(capacity=100, seed=0)
+    rows = {"obs": np.arange(50, dtype=np.float32)[:, None],
+            "is_first": np.zeros(50, np.float32)}
+    rep.add(rows)
+    assert len(rep) == 50
+    s = rep.sample(4, 8)
+    assert s["obs"].shape == (4, 8, 1)
+    # windows are contiguous runs of the flat store
+    for b in range(4):
+        d = np.diff(s["obs"][b, :, 0])
+        np.testing.assert_allclose(d, 1.0)
+
+
+def test_sequence_replay_never_straddles_ring_seam():
+    """After wraparound, windows must stay contiguous in TIME — a raw-index
+    window crossing the write pointer would stitch the newest rows onto the
+    oldest (r5 review finding)."""
+    from ray_tpu.rllib.algorithms.dreamerv3 import _SequenceReplay
+    rep = _SequenceReplay(capacity=32, seed=0)
+    for start in range(0, 80, 10):   # 80 rows through a 32-slot ring
+        rep.add({"obs": np.arange(start, start + 10,
+                                  dtype=np.float32)[:, None]})
+    assert len(rep) == 32
+    s = rep.sample(64, 6)
+    for b in range(64):
+        d = np.diff(s["obs"][b, :, 0])
+        np.testing.assert_allclose(d, 1.0, err_msg=str(s["obs"][b, :, 0]))
+
+
+@pytest.mark.parametrize("env", ["CartPole-v1", "Pendulum-v1"])
+def test_dreamerv3_trains(env):
+    from ray_tpu.rllib import DreamerV3Config
+    algo = (DreamerV3Config()
+            .environment(env)
+            .training(deter=64, stoch=4, classes=4,
+                      model={"hiddens": (64, 64)},
+                      batch_size_B=4, batch_length_T=12, horizon=5,
+                      rollout_fragment_length=64,
+                      num_steps_sampled_before_learning_starts=128)
+            .debugging(seed=3)
+            .build())
+    learned = False
+    for _ in range(4):
+        result = algo.train()
+        assert result["num_env_steps_sampled_this_iter"] == 64
+        if "learner" in result:
+            learned = True
+            lm = result["learner"]
+            for k in ("wm_loss", "wm_recon", "wm_kl_dyn", "actor_loss",
+                      "critic_loss", "imagined_return"):
+                assert np.isfinite(lm[k]), (k, lm)
+            assert lm["return_scale"] > 0
+    assert learned
+
+
+def test_dreamerv3_world_model_learns_dynamics():
+    """On a deterministic env the recon loss must drop markedly as the RSSM
+    fits the transition structure."""
+    from ray_tpu.rllib import DreamerV3Config
+    algo = (DreamerV3Config()
+            .environment("CartPole-v1")
+            .training(deter=64, stoch=4, classes=4,
+                      model={"hiddens": (64, 64)},
+                      batch_size_B=8, batch_length_T=16, horizon=5,
+                      rollout_fragment_length=128,
+                      num_steps_sampled_before_learning_starts=128,
+                      train_intensity=8)
+            .debugging(seed=1)
+            .build())
+    first, last = None, None
+    for _ in range(6):
+        result = algo.train()
+        lm = result.get("learner")
+        if lm:
+            if first is None:
+                first = lm["wm_recon"]
+            last = lm["wm_recon"]
+    assert first is not None
+    assert last < first * 0.7, (first, last)
+
+
+def test_dreamerv3_weight_roundtrip():
+    from ray_tpu.rllib import DreamerV3Config
+    import jax
+    mk = lambda seed: (DreamerV3Config().environment("CartPole-v1")
+                       .training(deter=32, stoch=4, classes=4,
+                                 model={"hiddens": (32,)},
+                                 rollout_fragment_length=8,
+                                 num_steps_sampled_before_learning_starts=4,
+                                 batch_size_B=2, batch_length_T=6, horizon=3)
+                       .debugging(seed=seed).build())
+    a, b = mk(0), mk(1)
+    a.train()
+    b.set_weights(a.get_weights())
+    la = jax.tree_util.tree_leaves(a.weights["wm"])
+    lb = jax.tree_util.tree_leaves(b.weights["wm"])
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
