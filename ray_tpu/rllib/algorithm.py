@@ -31,6 +31,11 @@ class AlgorithmConfig:
         self.num_envs_per_env_runner = 1
         self.rollout_fragment_length = 200
         self.explore = True
+        # placement of remote runner actors across the cluster (reference:
+        # env runners are plain actors the scheduler SPREADs over nodes —
+        # BASELINE config #5 "TPU learner + CPU rollout actors on workers")
+        self.env_runner_scheduling_strategy = None   # e.g. "SPREAD"
+        self.env_runner_resources: Dict = {}         # e.g. {"worker_node": 0.1}
         # training
         self.lr = 3e-4
         self.gamma = 0.99
@@ -66,7 +71,8 @@ class AlgorithmConfig:
         return self
 
     def env_runners(self, *, num_env_runners=None, num_envs_per_env_runner=None,
-                    rollout_fragment_length=None, explore=None, **_):
+                    rollout_fragment_length=None, explore=None,
+                    scheduling_strategy=None, resources=None, **_):
         if num_env_runners is not None:
             self.num_env_runners = num_env_runners
         if num_envs_per_env_runner is not None:
@@ -75,6 +81,10 @@ class AlgorithmConfig:
             self.rollout_fragment_length = rollout_fragment_length
         if explore is not None:
             self.explore = explore
+        if scheduling_strategy is not None:
+            self.env_runner_scheduling_strategy = scheduling_strategy
+        if resources is not None:
+            self.env_runner_resources = dict(resources)
         return self
 
     def training(self, *, lr=None, gamma=None, train_batch_size=None,
@@ -202,7 +212,13 @@ class Algorithm:
         import ray_tpu
         if not ray_tpu.is_initialized():
             ray_tpu.init()
-        RemoteRunner = ray_tpu.remote(num_cpus=1)(EnvRunner)
+        decorator = {"num_cpus": 1}
+        if cfg.env_runner_resources:
+            decorator["resources"] = dict(cfg.env_runner_resources)
+        if cfg.env_runner_scheduling_strategy is not None:
+            decorator["scheduling_strategy"] = \
+                cfg.env_runner_scheduling_strategy
+        RemoteRunner = ray_tpu.remote(**decorator)(EnvRunner)
         self._runner_handles = [
             RemoteRunner.remote(**{**self._make_runner_kwargs(),
                                    "seed": cfg.seed + i})

@@ -249,7 +249,7 @@ class _SequenceReplay:
         # sample in LOGICAL (time) order so no window straddles the ring's
         # write seam: logical 0 is the oldest row (raw _ptr once wrapped)
         base = self._ptr if self._n == self.capacity else 0
-        starts = self._rng.integers(0, self._n - t, size=b)
+        starts = self._rng.integers(0, self._n - t + 1, size=b)
         idx = (base + starts[:, None] + np.arange(t)[None, :]) % self.capacity
         return {k: v[idx] for k, v in self._store.items()}
 
@@ -316,8 +316,9 @@ class DreamerV3(Algorithm):
         self._r_arrival = 0.0
         self._ep_ret = 0.0
         self._ep_len = 0
-        self._ep_returns = []
-        self._ep_lens = []
+        import collections
+        self._ep_returns = collections.deque(maxlen=100)
+        self._ep_lens = collections.deque(maxlen=100)
         self._build_fns()
 
     # ------------------------------------------------------------- jit: act
@@ -398,12 +399,13 @@ class DreamerV3(Algorithm):
                 return jnp.sum(jnp.sum(
                     jnp.exp(p_lg) * (p_lg - q_lg), -1), -1)
 
-            dyn = jnp.maximum(cfg.free_nats,
-                              jnp.mean(kl(jax.lax.stop_gradient(post_lg),
-                                          prior_lg)))
-            rep = jnp.maximum(cfg.free_nats,
-                              jnp.mean(kl(post_lg,
-                                          jax.lax.stop_gradient(prior_lg))))
+            # free bits clip PER STATE, before the mean — clipping the mean
+            # would zero ALL KL gradients once the average dips under the
+            # threshold, letting outlier states' priors drift unpenalized
+            dyn = jnp.mean(jnp.maximum(
+                cfg.free_nats, kl(jax.lax.stop_gradient(post_lg), prior_lg)))
+            rep = jnp.mean(jnp.maximum(
+                cfg.free_nats, kl(post_lg, jax.lax.stop_gradient(prior_lg))))
             loss = (recon_loss + reward_loss + cont_loss +
                     cfg.kl_dyn_scale * dyn + cfg.kl_rep_scale * rep)
             metrics = {"wm_recon": recon_loss, "wm_reward": reward_loss,
@@ -624,9 +626,9 @@ class DreamerV3(Algorithm):
                    "num_env_steps_sampled": self.env_steps}
         if self._ep_returns:
             metrics["episode_return_mean"] = float(
-                np.mean(self._ep_returns[-20:]))
+                np.mean(list(self._ep_returns)[-20:]))
             metrics["episode_len_mean"] = float(
-                np.mean(self._ep_lens[-20:]))
+                np.mean(list(self._ep_lens)[-20:]))
         if (self.env_steps < cfg.num_steps_sampled_before_learning_starts or
                 len(self.replay) < cfg.batch_length_T + 1):
             return metrics
@@ -647,9 +649,17 @@ class DreamerV3(Algorithm):
         # the training env loop IS the policy rollout; report recent returns
         if not self._ep_returns:
             return {}
-        recent = self._ep_returns[-self.config.evaluation_duration:]
+        recent = list(self._ep_returns)[-self.config.evaluation_duration:]
         return {"episodes_this_iter": len(recent),
                 "episode_return_mean": float(np.mean(recent))}
+
+    def stop(self):
+        # this algorithm owns its env directly (no EnvRunner fleet closes it)
+        try:
+            self._env.close()
+        except Exception:
+            pass
+        super().stop()
 
     def get_weights(self):
         return jax.device_get(self.weights)

@@ -177,6 +177,13 @@ class EnvRunner:
         return self.pop_metrics()
 
     # -- metrics ------------------------------------------------------------
+    def node_info(self) -> Dict:
+        """Where this runner lives — lets drivers/tests verify cluster
+        placement (multi-node SPREAD, BASELINE config #5 shape)."""
+        import os
+        return {"pid": os.getpid(), "ppid": os.getppid(),
+                "hostname": __import__("socket").gethostname()}
+
     def num_completed_episodes(self) -> int:
         return len(self._completed)
 

@@ -394,6 +394,37 @@ def test_node_death_by_heartbeat_silence():
     """)
 
 
+def test_rllib_env_runners_spread_across_nodes():
+    """BASELINE config #5 shape (VERDICT r4 next #7): PPO's EnvRunner actors
+    SPREAD across head + worker node feed the head-resident learner. The
+    runners' node_info proves one lives under each host's worker pool, and
+    training still converges metrics end-to-end through the cluster plane."""
+    _run_driver("""
+    from ray_tpu.rllib import PPOConfig
+
+    algo = (PPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=2, num_envs_per_env_runner=2,
+                         rollout_fragment_length=32,
+                         scheduling_strategy="SPREAD")
+            .training(train_batch_size=128, minibatch_size=64, num_epochs=1,
+                      lr=3e-4)
+            .debugging(seed=0)
+            .build())
+    try:
+        infos = ray.get([r.node_info.remote() for r in algo._runner_handles],
+                        timeout=180)
+        # one runner under EACH host's worker pool (different parent procs)
+        assert len({i["ppid"] for i in infos}) == 2, infos
+        for _ in range(2):
+            result = algo.train()
+            assert np.isfinite(result["learner"]["total_loss"]), result
+            assert result["num_env_steps_sampled_this_iter"] > 0
+    finally:
+        algo.stop()
+    """, timeout=360)
+
+
 def test_trainer_orchestrates_spmd_across_nodes():
     """Trainer.fit(ScalingConfig(num_workers=2)) composes the cluster plane
     with SPMD training (VERDICT r4 missing #2): the trainer itself places
