@@ -388,7 +388,8 @@ class Controller:
         # node-provider provisioning (autoscaler/node_provider.py)
         self.node_provider = None
         self.provider_max_nodes = 0
-        self._provider_nodes: Dict[str, float] = {}  # handle -> promised CPU
+        # handle -> promised resources ({"CPU": c, "num_tpus": t})
+        self._provider_nodes: Dict[str, Dict[str, float]] = {}
         # env keys with an async build in flight (built off-loop: a pip venv
         # install can take minutes and must not freeze the controller)
         self._env_building: Set[str] = set()
@@ -1079,11 +1080,13 @@ class Controller:
         semantics (a new call replaces the prior request), like the
         reference. Returns what was fulfilled vs clamped."""
         target = int(num_cpus or 0)
+        target_tpus = 0.0
         for b in bundles or []:
             target += int(b.get("CPU", 0) or 0)
+            target_tpus += float(b.get("num_tpus", 0) or 0)
         self.resource_requests = {
             "num_cpus": num_cpus, "bundles": bundles, "target_cpus": target,
-            "ts": time.time()}
+            "target_tpus": target_tpus, "ts": time.time()}
         n_alive = sum(
             1 for w in list(self.workers.values()) + list(self.spawning.values())
             if w.actor_id is None and not w.tpu_capable
@@ -1099,9 +1102,13 @@ class Controller:
         # repeated request doesn't double-launch; dead handles are pruned so
         # a crashed node doesn't count as capacity forever.
         launched_nodes = []
-        clamped = target > want
+        # without a provider, a TPU demand beyond current capacity can never
+        # be met — report it clamped instead of silently "satisfied"
+        clamped = (target > want
+                   or target_tpus > self.res_total().get("num_tpus", 0.0)
+                   + 1e-9)
         if (self.cluster is not None and self.node_provider is not None
-                and target > 0):
+                and (target > 0 or target_tpus > 0)):
             live = set(self.node_provider.non_terminated_nodes())
             self._provider_nodes = {
                 h: c for h, c in self._provider_nodes.items() if h in live}
@@ -1109,26 +1116,59 @@ class Controller:
             # res_total; add only the promise of live handles whose agent
             # has not registered yet (matched by pid when the provider can)
             pid_of = getattr(self.node_provider, "pid_of", lambda _h: None)
+            pids_of = getattr(self.node_provider, "pids_of", None)
             reg_pids = {n.pid for n in self.cluster.nodes.values()}
-            promised = sum(c for h, c in self._provider_nodes.items()
-                           if pid_of(h) not in reg_pids)
-            per_node = float(getattr(self.node_provider, "cpus_per_node", 2.0))
-            projected = self.res_total().get("CPU", 0.0) + promised
-            while (projected + 1e-9 < target
-                   and len(self._provider_nodes) < self.provider_max_nodes):
+            promised = {"CPU": 0.0, "num_tpus": 0.0}
+            for h, c in self._provider_nodes.items():
+                if pids_of is not None:
+                    # multi-host handles (TPU slices): the promise drains
+                    # fractionally as each host registers — a half-arrived
+                    # pod must not trigger a second whole-pod launch
+                    pids = pids_of(h)
+                    frac = (sum(1 for p in pids if p not in reg_pids)
+                            / len(pids)) if pids else 1.0
+                else:
+                    frac = 0.0 if pid_of(h) in reg_pids else 1.0
+                promised["CPU"] += c.get("CPU", 0.0) * frac
+                promised["num_tpus"] += c.get("num_tpus", 0.0) * frac
+            per_node = {
+                "CPU": float(getattr(self.node_provider, "cpus_per_node",
+                                     2.0)),
+                "num_tpus": float(getattr(self.node_provider,
+                                          "tpus_per_node", 0.0))}
+            totals = self.res_total()
+            projected = {
+                "CPU": totals.get("CPU", 0.0) + promised["CPU"],
+                "num_tpus": totals.get("num_tpus", 0.0)
+                + promised["num_tpus"]}
+
+            def unmet():
+                cpu_short = (projected["CPU"] + 1e-9 < target
+                             and per_node["CPU"] > 0)
+                tpu_short = (projected["num_tpus"] + 1e-9 < target_tpus
+                             and per_node["num_tpus"] > 0)
+                return cpu_short or tpu_short
+
+            # zero-valued entries must not reach providers as resources
+            # (a subprocess node would register a pointless num_tpus: 0)
+            launch_res = {k: v for k, v in per_node.items() if v > 0}
+            while unmet() and len(self._provider_nodes) < \
+                    self.provider_max_nodes:
                 try:
                     handle = self.node_provider.create_node(
-                        {"CPU": per_node}, self.cluster.address)
+                        launch_res, self.cluster.address)
                 except Exception as e:  # noqa: BLE001 - provisioning failure
                     print(f"[autoscaler] node launch failed: {e!r}",
                           file=sys.stderr)
                     break
-                self._provider_nodes[handle] = per_node
+                self._provider_nodes[handle] = dict(per_node)
                 launched_nodes.append(handle)
-                projected += per_node
-            clamped = projected + 1e-9 < target
+                projected["CPU"] += per_node["CPU"]
+                projected["num_tpus"] += per_node["num_tpus"]
+            clamped = (projected["CPU"] + 1e-9 < target
+                       or projected["num_tpus"] + 1e-9 < target_tpus)
         return {"target_cpus": target, "fulfilled_cpus": want,
-                "clamped": clamped,
+                "target_tpus": target_tpus, "clamped": clamped,
                 "spawned_workers": spawned, "launched_nodes": launched_nodes}
 
     def set_node_provider(self, provider, max_nodes: int = 4):

@@ -12,6 +12,9 @@ Two units of elasticity, mirroring the reference split:
 """
 
 from ray_tpu.autoscaler import sdk
+from ray_tpu.autoscaler.gcp_tpu import (FakeTpuApi, GcloudTpuApi,
+                                        GcpTpuNodeProvider, slice_info)
 from ray_tpu.autoscaler.node_provider import NodeProvider, SubprocessNodeProvider
 
-__all__ = ["sdk", "NodeProvider", "SubprocessNodeProvider"]
+__all__ = ["sdk", "NodeProvider", "SubprocessNodeProvider",
+           "GcpTpuNodeProvider", "GcloudTpuApi", "FakeTpuApi", "slice_info"]

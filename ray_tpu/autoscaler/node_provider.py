@@ -23,6 +23,24 @@ import time
 from typing import Dict, List, Optional
 
 
+def spawn_agent(head_address: str, num_cpus: float,
+                resources: Optional[Dict[str, float]] = None,
+                env: Optional[Dict[str, str]] = None) -> subprocess.Popen:
+    """Launch one `node_main` agent that dials into `head_address` — the
+    single agent-launch contract shared by every local/fake provider (a
+    node is its own session: never inherit the head's arena/socket)."""
+    import json
+    env = dict(env if env is not None else os.environ)
+    env.pop("RAY_TPU_ARENA", None)
+    env.pop("RAY_TPU_ADDRESS", None)
+    cmd = [sys.executable, "-m", "ray_tpu._private.node_main",
+           "--address", head_address, "--num-cpus", str(num_cpus)]
+    if resources:
+        cmd += ["--resources", json.dumps(resources)]
+    return subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL,
+                            start_new_session=True)
+
+
 class NodeProvider:
     """Minimal provisioning interface (ref: node_provider.py:1-120).
 
@@ -66,21 +84,12 @@ class SubprocessNodeProvider(NodeProvider):
 
     def create_node(self, resources: Dict[str, float],
                     head_address: str) -> str:
-        import json
-        env = dict(self.env if self.env is not None else os.environ)
-        # a node is its own session: never inherit the head's arena/socket
-        env.pop("RAY_TPU_ARENA", None)
-        env.pop("RAY_TPU_ADDRESS", None)
         extra = {**self.extra_resources,
                  **{k: v for k, v in resources.items()
                     if k not in ("CPU", "memory")}}
-        cmd = [sys.executable, "-m", "ray_tpu._private.node_main",
-               "--address", head_address,
-               "--num-cpus", str(resources.get("CPU", self.cpus_per_node))]
-        if extra:
-            cmd += ["--resources", json.dumps(extra)]
-        proc = subprocess.Popen(cmd, env=env, stdin=subprocess.DEVNULL,
-                                start_new_session=True)
+        proc = spawn_agent(head_address,
+                           resources.get("CPU", self.cpus_per_node),
+                           extra or None, self.env)
         self._n += 1
         handle = f"subproc-node-{self._n}-pid{proc.pid}"
         self._procs[handle] = proc

@@ -394,6 +394,44 @@ def test_node_death_by_heartbeat_silence():
     """)
 
 
+def test_gcp_tpu_provider_scales_up_fake_v5e():
+    """A TPU-pod-shaped provider (VERDICT r4 next #8): requesting num_tpus
+    beyond cluster capacity launches a fake v5e-8 through the provider seam;
+    its host agent registers carrying num_tpus=8 and a num_tpus actor
+    schedules onto it."""
+    _run_driver("""
+    from ray_tpu.autoscaler import (FakeTpuApi, GcpTpuNodeProvider, sdk)
+
+    provider = GcpTpuNodeProvider(accelerator_type="v5litepod-8",
+                                  api=FakeTpuApi(env=env))
+    sdk.set_node_provider(provider, max_nodes=2)
+
+    # no TPUs anywhere yet → the request must launch exactly one slice
+    out = sdk.request_resources(bundles=[{"num_tpus": 8}])
+    assert len(out["launched_nodes"]) == 1, out
+    assert out["target_tpus"] == 8.0
+    wait_for(lambda: ray.cluster_resources().get("num_tpus", 0) == 8.0,
+             90, "fake TPU slice registering")
+    assert ray.cluster_resources()["accelerator_type:v5litepod-8"] == 1.0
+
+    # repeated identical request: capacity is met, no double-launch
+    out2 = sdk.request_resources(bundles=[{"num_tpus": 8}])
+    assert out2["launched_nodes"] == [], out2
+
+    # a num_tpus actor lands on the fake slice host, not the head
+    @ray.remote(resources={"num_tpus": 8})
+    class TpuWorker:
+        def where(self):
+            return os.getppid()
+    w = TpuWorker.remote()
+    assert ray.get(w.where.remote(), timeout=120) != os.getpid()
+
+    provider.shutdown()
+    wait_for(lambda: ray.cluster_resources().get("num_tpus", 0) == 0,
+             60, "fake slice leaving")
+    """, timeout=300)
+
+
 def test_rllib_env_runners_spread_across_nodes():
     """BASELINE config #5 shape (VERDICT r4 next #7): PPO's EnvRunner actors
     SPREAD across head + worker node feed the head-resident learner. The
