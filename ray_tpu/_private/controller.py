@@ -1118,15 +1118,29 @@ class Controller:
             pid_of = getattr(self.node_provider, "pid_of", lambda _h: None)
             pids_of = getattr(self.node_provider, "pids_of", None)
             reg_pids = {n.pid for n in self.cluster.nodes.values()}
+            # pid-less providers (real cloud APIs) drain promises by
+            # counting registered nodes carrying their marker resource
+            marker = getattr(self.node_provider, "registration_marker", None)
+            hosts_per_handle = float(getattr(self.node_provider,
+                                             "hosts_per_node", 1.0)) or 1.0
+            marker_arrived = (sum(
+                1 for n in self.cluster.nodes.values()
+                if n.alive and n.resources.get(marker))
+                if marker is not None else 0.0)
             promised = {"CPU": 0.0, "num_tpus": 0.0}
             for h, c in self._provider_nodes.items():
-                if pids_of is not None:
+                pids = pids_of(h) if pids_of is not None else None
+                if pids:
                     # multi-host handles (TPU slices): the promise drains
                     # fractionally as each host registers — a half-arrived
                     # pod must not trigger a second whole-pod launch
-                    pids = pids_of(h)
                     frac = (sum(1 for p in pids if p not in reg_pids)
-                            / len(pids)) if pids else 1.0
+                            / len(pids))
+                elif pids is None and marker is not None:
+                    # attribute arrived marker hosts to handles oldest-first
+                    take = min(hosts_per_handle, marker_arrived)
+                    marker_arrived -= take
+                    frac = 1.0 - take / hosts_per_handle
                 else:
                     frac = 0.0 if pid_of(h) in reg_pids else 1.0
                 promised["CPU"] += c.get("CPU", 0.0) * frac

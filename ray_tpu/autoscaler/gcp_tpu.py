@@ -88,6 +88,7 @@ class GcloudTpuApi:
         self.zone = zone
         self.dry_run = dry_run
         self.commands: List[List[str]] = []   # dry-run ledger
+        self.scripts: Dict[str, str] = {}     # name → startup script text
         self._dry_nodes: Dict[str, str] = {}  # name → state
 
     def _run(self, cmd: List[str]) -> str:
@@ -104,7 +105,6 @@ class GcloudTpuApi:
         # --metadata splits its value on commas (the script's JSON has
         # them), so the script must travel via --metadata-from-file
         import tempfile
-        self.scripts: Dict[str, str] = getattr(self, "scripts", {})
         self.scripts[name] = startup_script
         if self.dry_run:
             script_path = f"<startup-script:{name}>"
@@ -118,7 +118,14 @@ class GcloudTpuApi:
                "--accelerator-type", accelerator_type,
                "--version", runtime_version,
                "--metadata-from-file", f"startup-script={script_path}"]
-        self._run(cmd)
+        try:
+            self._run(cmd)
+        finally:
+            if not self.dry_run:
+                try:  # gcloud read it synchronously during _run
+                    os.unlink(script_path)
+                except OSError:
+                    pass
         if self.dry_run:
             self._dry_nodes[name] = "READY"
 
@@ -222,6 +229,10 @@ class GcpTpuNodeProvider(NodeProvider):
         info = slice_info(accelerator_type)
         self.cpus_per_node = float(info["hosts"])   # 1 agent cpu per host
         self.tpus_per_node = float(info["chips"])
+        self.hosts_per_node = float(info["hosts"])
+        # pid-less mode (real gcloud API): the head drains launch promises
+        # by counting registered nodes that carry this marker resource
+        self.registration_marker = f"accelerator_type:{accelerator_type}"
         self._n = 0
         self._handles: List[str] = []
 
@@ -253,11 +264,15 @@ class GcpTpuNodeProvider(NodeProvider):
         pids = self.pids_of(handle)
         return pids[0] if pids else None
 
-    def pids_of(self, handle: str) -> List[int]:
-        """All host agent pids for a slice (FakeTpuApi only). The head
-        counts a slice's promise down fractionally as hosts register, so a
-        half-registered pod isn't double-launched (r5 review finding)."""
-        return list(getattr(self.api, "pids", lambda _h: [])(handle))
+    def pids_of(self, handle: str) -> Optional[List[int]]:
+        """All host agent pids for a slice, or None when the API cannot map
+        pids (real gcloud mode) — then the head falls back to draining
+        promises by registration_marker counting, so launched capacity
+        never double-counts against registered capacity."""
+        pids_fn = getattr(self.api, "pids", None)
+        if pids_fn is None:
+            return None
+        return list(pids_fn(handle))
 
     def shutdown(self):
         for h in list(self._handles):

@@ -7,6 +7,7 @@ adds a continuous-batching LLM replica on a jitted decode step.
 
 from .api import (delete, get_deployment_handle, grpc_port, run,
                   shutdown, start, status)
+from .asgi import ingress
 from .batching import batch
 from .deployment import AutoscalingConfig, Deployment, DeploymentConfig, deployment
 from .handle import DeploymentHandle, DeploymentResponse
@@ -19,6 +20,6 @@ __all__ = [
     "DeploymentResponse", "Request", "Response", "batch", "build_app_config",
     "delete", "deploy_config", "deployment", "get_deployment_handle",
     "grpc_port",
-    "get_multiplexed_model_id", "multiplexed", "run", "shutdown", "start",
-    "status",
+    "get_multiplexed_model_id", "ingress", "multiplexed", "run", "shutdown",
+    "start", "status",
 ]

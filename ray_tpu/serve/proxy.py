@@ -315,6 +315,7 @@ class ProxyActor:
             return True
         prefix, (app, deployment, is_stream) = match
         req.path = req.path[len(prefix):] or "/"
+        req.route_prefix = prefix   # ASGI ingresses mount here (root_path)
         # streaming is a property of the INGRESS (generator __call__, recorded
         # at deploy time) — an Accept header can't turn a unary deployment
         # into a stream (iterating its dict return would leak keys as events)

@@ -60,6 +60,19 @@ def test_dry_run_gcloud_contract():
                                     "delete"]
 
 
+def test_pidless_real_api_uses_marker_drain():
+    """Real gcloud mode can't map agent pids; the provider must say so
+    (pids_of → None) and expose the marker the head drains promises with,
+    or launched capacity double-counts forever (r5 review finding)."""
+    from ray_tpu.autoscaler import GcloudTpuApi, GcpTpuNodeProvider
+    api = GcloudTpuApi("p", "z", dry_run=True)
+    provider = GcpTpuNodeProvider(accelerator_type="v5litepod-16", api=api)
+    assert provider.pids_of("anything") is None
+    assert provider.pid_of("anything") is None
+    assert provider.registration_marker == "accelerator_type:v5litepod-16"
+    assert provider.hosts_per_node == 2.0
+
+
 def test_multihost_slice_launches_one_agent_per_host():
     """v5litepod-16 = 2 hosts → the fake API must start 2 agents, each
     advertising 8 chips (the reference treats the pod as one node whose

@@ -1,0 +1,129 @@
+"""@serve.ingress ASGI adapter (VERDICT r4 missing #7; ref:
+python/ray/serve/api.py:309). No starlette/fastapi in this image, so the
+tests drive a hand-rolled spec-conforming ASGI app — the adapter only
+speaks the ASGI protocol, any framework rides on it."""
+
+import asyncio
+import json
+
+import pytest
+
+from test_serve_http import _req
+
+
+def make_app(marker="v1"):
+    """A minimal ASGI app: GET /hello, POST /echo (reads body), GET /meta
+    (exposes scope root_path/path), 404 otherwise, chunked body response."""
+
+    async def app(scope, receive, send):
+        assert scope["type"] == "http"
+        path = scope["path"]
+        body = b""
+        while True:
+            event = await receive()
+            body += event.get("body", b"")
+            if not event.get("more_body"):
+                break
+
+        async def respond(status, payload, ctype=b"application/json"):
+            await send({"type": "http.response.start", "status": status,
+                        "headers": [(b"content-type", ctype),
+                                    (b"x-marker", marker.encode())]})
+            # two body events: the adapter must concatenate chunks
+            await send({"type": "http.response.body", "body": payload[:3],
+                        "more_body": True})
+            await send({"type": "http.response.body", "body": payload[3:]})
+
+        if scope["method"] == "GET" and path == "/hello":
+            await respond(200, json.dumps({"hello": marker}).encode())
+        elif scope["method"] == "POST" and path == "/echo":
+            await respond(200, json.dumps(
+                {"echo": body.decode(), "q": scope["query_string"].decode()}
+            ).encode())
+        elif path == "/meta":
+            await respond(200, json.dumps(
+                {"root_path": scope["root_path"], "path": path}).encode())
+        else:
+            await respond(404, b'{"detail": "nope"}')
+
+    return app
+
+
+def test_call_asgi_unit():
+    from ray_tpu.serve import Request
+    from ray_tpu.serve.asgi import call_asgi
+    app = make_app()
+    req = Request("POST", "/echo", query_string="a=1",
+                  headers={"Content-Type": "text/plain"}, body=b"hi there")
+    resp = asyncio.run(call_asgi(app, req))
+    assert resp.status_code == 200
+    assert json.loads(resp.content) == {"echo": "hi there", "q": "a=1"}
+    assert resp.headers["x-marker"] == "v1"
+
+    resp = asyncio.run(call_asgi(app, Request("GET", "/missing")))
+    assert resp.status_code == 404
+
+
+def test_ingress_requires_class():
+    from ray_tpu import serve
+    with pytest.raises(TypeError):
+        serve.ingress(make_app())(lambda req: req)
+
+
+@pytest.fixture()
+def serve_app(ray_session):
+    from ray_tpu import serve
+    yield serve
+    serve.shutdown()
+
+
+def test_asgi_ingress_end_to_end(serve_app):
+    serve = serve_app
+
+    @serve.deployment
+    @serve.ingress(make_app("live"))
+    class Api:
+        def direct(self):
+            return "handle-path still works"
+
+    serve.run(Api.bind(), name="api", route_prefix="/api")
+    port = serve.start(http_options={"port": 0})
+
+    status, headers, data = _req(port, "GET", "/api/hello")
+    assert status == 200, data
+    assert json.loads(data) == {"hello": "live"}
+    assert headers["x-marker"] == "live"
+
+    status, _, data = _req(port, "POST", "/api/echo?a=2", body=b"ping")
+    assert status == 200
+    assert json.loads(data) == {"echo": "ping", "q": "a=2"}
+
+    # the app sees itself mounted under the route prefix
+    status, _, data = _req(port, "GET", "/api/meta")
+    assert json.loads(data) == {"root_path": "/api", "path": "/meta"}
+
+    # app-level 404 (inside the deployment) is not a proxy 404
+    status, _, data = _req(port, "GET", "/api/nope")
+    assert status == 404 and json.loads(data) == {"detail": "nope"}
+
+    # non-ASGI methods remain reachable over handles
+    h = serve.get_deployment_handle("Api", "api")
+    assert h.direct.remote().result(timeout_s=60) == \
+        "handle-path still works"
+
+
+def test_asgi_factory_builds_per_replica(serve_app):
+    serve = serve_app
+
+    def build():   # zero-arg factory → called replica-side
+        return make_app("factory")
+
+    @serve.deployment
+    @serve.ingress(build)
+    class Api2:
+        pass
+
+    serve.run(Api2.bind(), name="api2", route_prefix="/api2")
+    port = serve.start(http_options={"port": 0})
+    status, _, data = _req(port, "GET", "/api2/hello")
+    assert status == 200 and json.loads(data) == {"hello": "factory"}
