@@ -81,14 +81,31 @@ def hybrid_mesh(ici_axes: Dict[str, int], dcn_axes: Dict[str, int]):
     Reference contrast: Ray spans hosts with GCS + NCCL over TCP; here the
     compiler handles cross-host collectives when the mesh is built with DCN
     as the outermost dimension (jax mesh_utils.create_hybrid_device_mesh).
+
+    The DCN granule is a TPU slice when devices report slice_index
+    (multi-slice pods); otherwise it falls back to one granule per PROCESS
+    (multi-host single-slice, and the CPU-backend dry-run world).
     """
     import jax
     from jax.sharding import Mesh
     from jax.experimental import mesh_utils
 
-    shape = tuple(ici_axes.values())
-    dcn_shape = tuple(dcn_axes.values())
-    dev = mesh_utils.create_hybrid_device_mesh(shape, dcn_shape, devices=jax.devices())
+    # create_hybrid_device_mesh takes equal-rank shapes and multiplies them
+    # per dimension: axis i spans mesh_shape[i] * dcn_mesh_shape[i] devices.
+    # DCN axes lead (outermost), so they get size 1 on the ICI side and
+    # vice versa.
+    shape = (1,) * len(dcn_axes) + tuple(ici_axes.values())
+    dcn_shape = tuple(dcn_axes.values()) + (1,) * len(ici_axes)
+    devices = jax.devices()
+    # granule choice is structural, not error-driven: slice-granule only
+    # when devices actually report slice_index (multi-slice pods). A
+    # blanket ValueError fallback would silently swallow real topology
+    # mistakes (e.g. dcn product != slice count) and mislabel ICI as DCN.
+    slices = {getattr(d, "slice_index", None) for d in devices}
+    procs = {d.process_index for d in devices}
+    by_process = len(slices - {None}) <= 1 and len(procs) > 1
+    dev = mesh_utils.create_hybrid_device_mesh(
+        shape, dcn_shape, devices=devices, process_is_granule=by_process)
     return Mesh(dev, tuple(dcn_axes.keys()) + tuple(ici_axes.keys()))
 
 
