@@ -523,13 +523,16 @@ def measure(config_name):
     batch, seq, _ = _CONFIGS[config_name]
     if not on_tpu:
         batch, seq = 2, 256
+    # perf-sweep overrides (r5: how the MFU tuning experiments are driven)
+    batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", batch))
+    remat = os.environ.get("RAY_TPU_BENCH_REMAT", "1") != "0"
     if config_name == "llama_1b":
         # bf16 params + remat: ~0.9B params -> 1.7G params + 1.7G grads +
         # 3.4G adam (mu/nu mirror param dtype) fits a 16G v5e chip.
         # attn_impl pinned to "flash": with RAY_TPU_STRICT_FLASH the run DIES
         # rather than silently timing the O(T²) reference path (r2 weak #4).
         cfg = LlamaConfig.llama_1b(max_seq_len=seq, param_dtype=jnp.bfloat16,
-                                   remat=True,
+                                   remat=remat,
                                    attn_impl="flash" if on_tpu else "auto")
         if on_tpu:
             os.environ["RAY_TPU_STRICT_FLASH"] = "1"

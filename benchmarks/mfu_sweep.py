@@ -1,0 +1,86 @@
+"""MFU tuning sweep (VERDICT r4 next #4): runs bench.py --measure under a
+grid of env overrides (batch, remat, flash block sizes) on the real chip and
+prints a ranked table. Each variant is a fresh subprocess so XLA state and
+HBM are clean between runs.
+
+Usage: python benchmarks/mfu_sweep.py [--budget-s 1800] [--steps-env ...]
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+VARIANTS = [
+    # name, env overrides
+    ("b4_remat_1024", {}),                        # current bench config
+    ("b8_remat_1024", {"RAY_TPU_BENCH_BATCH": "8"}),
+    ("b8_remat_512kv", {"RAY_TPU_BENCH_BATCH": "8",
+                        "RAY_TPU_FLASH_BLOCK_KV": "512"}),
+    ("b8_remat_2048kv", {"RAY_TPU_BENCH_BATCH": "8",
+                         "RAY_TPU_FLASH_BLOCK_KV": "2048"}),
+    ("b8_remat_512q", {"RAY_TPU_BENCH_BATCH": "8",
+                       "RAY_TPU_FLASH_BLOCK_Q": "512"}),
+    ("b4_noremat_1024", {"RAY_TPU_BENCH_REMAT": "0"}),
+    ("b8_noremat_1024", {"RAY_TPU_BENCH_BATCH": "8",
+                         "RAY_TPU_BENCH_REMAT": "0"}),
+    ("b16_remat_1024", {"RAY_TPU_BENCH_BATCH": "16"}),
+]
+
+
+def run_variant(name, overrides, timeout):
+    env = dict(os.environ)
+    env.update(overrides)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--measure",
+             "--config", "llama_1b"],
+            env=env, capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return {"name": name, "error": "timeout"}
+    rec = None
+    for line in reversed(r.stdout.strip().splitlines()):
+        try:
+            rec = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    if r.returncode != 0 or rec is None:
+        return {"name": name, "error": f"rc={r.returncode}",
+                "tail": r.stderr[-500:]}
+    return {"name": name, "mfu": rec.get("mfu"),
+            "tps_chip": rec.get("value"),
+            "ms_per_step": rec.get("ms_per_step"),
+            "batch": rec.get("batch"), "dt_s": round(time.time() - t0, 1)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--budget-s", type=float, default=3000)
+    ap.add_argument("--per-run-timeout", type=float, default=600)
+    args = ap.parse_args()
+    deadline = time.time() + args.budget_s
+    results = []
+    for name, overrides in VARIANTS:
+        if time.time() + args.per_run_timeout > deadline:
+            print(f"# budget exhausted, skipping {name}", file=sys.stderr)
+            continue
+        out = run_variant(name, overrides, args.per_run_timeout)
+        results.append(out)
+        print(json.dumps(out), flush=True)
+    good = [r for r in results if r.get("mfu")]
+    good.sort(key=lambda r: -r["mfu"])
+    print("\n# ranked:")
+    for r in good:
+        print(f"#  {r['name']:<20} mfu={r['mfu']:.4f} "
+              f"tps/chip={r['tps_chip']:,.0f} ms/step={r['ms_per_step']}")
+
+
+if __name__ == "__main__":
+    main()

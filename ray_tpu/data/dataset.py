@@ -11,6 +11,7 @@ import numpy as np
 import pyarrow as pa
 
 from . import block as B
+from .fsutil import resolve_fs as _resolve_fs
 from .plan import AllToAllOp, BlockOp, Plan, Source
 from .streaming import ShuffleOp
 
@@ -450,6 +451,9 @@ class Dataset:
         return _idb(host, sharding=sharding, prefetch=prefetch)
 
     # ---------------------------------------------------------------- writes
+    # Paths may be plain local paths OR filesystem URIs (file://, gs://,
+    # s3://, ...) — resolved through pyarrow.fs like the reference's
+    # cloud-fs write matrix (ref: python/ray/data/dataset.py:4522-4724).
     def write_parquet(self, path: str) -> None:
         self._write(path, "parquet")
 
@@ -459,19 +463,52 @@ class Dataset:
     def write_json(self, path: str) -> None:
         self._write(path, "json")
 
+    def write_images(self, path: str, column: str = "image",
+                     filename_column: Optional[str] = None,
+                     file_format: str = "png") -> None:
+        """Encode an image column (HWC uint8 arrays) to one file per row
+        (ref: python/ray/data/dataset.py:4522 write_images)."""
+        import io
+
+        from PIL import Image
+
+        # PIL registers "JPEG", not the common "jpg" spelling
+        pil_format = {"jpg": "JPEG"}.get(file_format.lower(),
+                                         file_format.upper())
+        fsys, root = _resolve_fs(path)
+        fsys.create_dir(root, recursive=True)
+        row_idx = 0
+        for blk in self._plan.iter_blocks():
+            # numpy block format restores tensor-column shapes (to_pandas
+            # would flatten fixed-shape tensor arrays to 1-D lists)
+            cols = B.block_to_format(blk, "numpy")
+            names = cols.get(filename_column) if filename_column else None
+            for j in range(len(cols[column])):
+                arr = np.asarray(cols[column][j]).astype("uint8")
+                name = (str(names[j]) if names is not None
+                        else f"img-{row_idx:06d}.{file_format}")
+                buf = io.BytesIO()
+                Image.fromarray(arr).save(buf, format=pil_format)
+                with fsys.open_output_stream(f"{root}/{name}") as f:
+                    f.write(buf.getvalue())
+                row_idx += 1
+
     def _write(self, path: str, fmt: str) -> None:
-        import os
-        os.makedirs(path, exist_ok=True)
+        fsys, root = _resolve_fs(path)
+        fsys.create_dir(root, recursive=True)
         for i, blk in enumerate(self._plan.iter_blocks()):
-            fp = os.path.join(path, f"part-{i:05d}.{fmt}")
+            fp = f"{root}/part-{i:05d}.{fmt}"
             if fmt == "parquet":
                 import pyarrow.parquet as pq
-                pq.write_table(blk, fp)
+                pq.write_table(blk, fp, filesystem=fsys)
             elif fmt == "csv":
                 import pyarrow.csv as pcsv
-                pcsv.write_csv(blk, fp)
+                with fsys.open_output_stream(fp) as f:
+                    pcsv.write_csv(blk, f)
             else:
-                blk.to_pandas().to_json(fp, orient="records", lines=True)
+                with fsys.open_output_stream(fp) as f:
+                    f.write(blk.to_pandas().to_json(
+                        orient="records", lines=True).encode())
 
     def __repr__(self):
         return f"Dataset(ops={[type(o).__name__ for o in self._plan.ops]})"

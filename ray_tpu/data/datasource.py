@@ -65,11 +65,14 @@ def from_arrow(tables) -> Dataset:
 
 
 def _expand_paths(paths, suffix: Optional[str] = None) -> List[str]:
+    from .fsutil import expand_uri_dir
     if isinstance(paths, str):
         paths = [paths]
     out: List[str] = []
     for p in paths:
-        if os.path.isdir(p):
+        if "://" in str(p):   # cloud-fs / file:// URIs via pyarrow.fs
+            out.extend(expand_uri_dir(p, suffix))
+        elif os.path.isdir(p):
             inner = sorted(_glob.glob(os.path.join(p, "*")))
             out.extend(f for f in inner
                        if suffix is None or f.endswith(suffix))
@@ -88,7 +91,9 @@ def read_parquet(paths, **_compat) -> Dataset:
     def reader(fp):
         def thunk():
             import pyarrow.parquet as pq
-            return pq.read_table(fp)
+            from .fsutil import resolve_fs
+            fsys, rel = resolve_fs(fp)   # resolved IN the executing task
+            return pq.read_table(rel, filesystem=fsys)
         return thunk
 
     return Dataset(Plan(Source([reader(f) for f in files], "read_parquet")))
@@ -100,7 +105,10 @@ def read_csv(paths, **_compat) -> Dataset:
     def reader(fp):
         def thunk():
             import pyarrow.csv as pcsv
-            return pcsv.read_csv(fp)
+            from .fsutil import resolve_fs
+            fsys, rel = resolve_fs(fp)
+            with fsys.open_input_stream(rel) as f:
+                return pcsv.read_csv(f)
         return thunk
 
     return Dataset(Plan(Source([reader(f) for f in files], "read_csv")))
@@ -112,7 +120,10 @@ def read_json(paths, **_compat) -> Dataset:
     def reader(fp):
         def thunk():
             import pyarrow.json as pjson
-            return pjson.read_json(fp)
+            from .fsutil import resolve_fs
+            fsys, rel = resolve_fs(fp)
+            with fsys.open_input_stream(rel) as f:
+                return pjson.read_json(f)
         return thunk
 
     return Dataset(Plan(Source([reader(f) for f in files], "read_json")))
@@ -123,8 +134,13 @@ def read_text(paths, **_compat) -> Dataset:
 
     def reader(fp):
         def thunk():
-            with open(fp, "r") as f:
-                lines = [ln.rstrip("\n") for ln in f]
+            from .fsutil import resolve_fs
+            fsys, rel = resolve_fs(fp)
+            with fsys.open_input_stream(rel) as f:
+                text = f.read().decode("utf-8")
+            lines = text.split("\n")
+            if lines and lines[-1] == "":
+                lines.pop()
             return B.block_from_numpy_dict({"text": np.asarray(lines, object)})
         return thunk
 
@@ -136,11 +152,13 @@ def read_binary_files(paths, *, include_paths: bool = False, **_compat) -> Datas
 
     def reader(fp):
         def thunk():
-            with open(fp, "rb") as f:
+            from .fsutil import resolve_fs
+            fsys, rel = resolve_fs(fp)
+            with fsys.open_input_stream(rel) as f:
                 data = f.read()
             cols: Dict[str, Any] = {"bytes": pa.array([data], pa.binary())}
             if include_paths:
-                cols["path"] = pa.array([fp])
+                cols["path"] = pa.array([str(fp)])
             return pa.table(cols)
         return thunk
 

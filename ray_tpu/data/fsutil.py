@@ -1,0 +1,65 @@
+"""Filesystem-URI resolution shared by data readers and writers.
+
+Reference parity: python/ray/data reads/writes through pyarrow.fs /
+fsspec so `gs://`, `s3://`, `file://` URIs work everywhere a local path
+does (ref: python/ray/data/datasource/path_util.py). Plain paths stay on
+the local filesystem; URIs resolve through pyarrow.fs.FileSystem.from_uri
+(GCS/S3/HDFS support comes from pyarrow itself — no extra deps)."""
+
+from typing import List, Tuple
+
+
+class UriPath(str):
+    """A child path discovered under a URI directory.
+
+    Behaves as a plain display string, but carries the ORIGINAL base URI
+    so the executing task re-resolves the filesystem from it — naive
+    `scheme://path` reconstruction would drop the URI authority
+    (hdfs://namenode:8020) and query params (s3 endpoint_override).
+    Pickles across task boundaries."""
+
+    def __new__(cls, display: str, base_uri: str, rel: str):
+        s = super().__new__(cls, display)
+        s.base_uri = base_uri
+        s.rel = rel
+        return s
+
+    def __reduce__(self):
+        return (UriPath, (str(self), self.base_uri, self.rel))
+
+
+def resolve_fs(path) -> Tuple[object, str]:
+    """path | URI | UriPath → (pyarrow FileSystem, fs-relative path)."""
+    from pyarrow import fs as pafs
+    if isinstance(path, UriPath):
+        fsys, _root = pafs.FileSystem.from_uri(path.base_uri)
+        return fsys, path.rel
+    p = str(path)
+    if "://" in p:
+        return pafs.FileSystem.from_uri(p)
+    return pafs.LocalFileSystem(), p
+
+
+def expand_uri_dir(path, suffix=None) -> List[UriPath]:
+    """List files under a URI (dir or single file) as UriPath entries.
+    `suffix` filters strictly, matching the local-directory behavior."""
+    from pyarrow import fs as pafs
+    base = str(path)
+    fsys, rel = resolve_fs(base)
+    info = fsys.get_file_info(rel)
+    if info.type == pafs.FileType.Directory:
+        infos = fsys.get_file_info(pafs.FileSelector(rel))
+        names = sorted(i.path for i in infos
+                       if i.type == pafs.FileType.File)
+    elif info.type == pafs.FileType.File:
+        names = [rel]
+    else:
+        raise FileNotFoundError(path)
+    if suffix is not None:
+        names = [n for n in names if n.endswith(suffix)]
+    # display form looks like a child URI (so .endswith(ext) filters work)
+    # but resolution always goes through base_uri + rel
+    return [UriPath(base if n == rel
+                    else f"{base.rstrip('/')}/{n.rsplit('/', 1)[-1]}",
+                    base, n)
+            for n in names]

@@ -40,8 +40,15 @@ def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
                                     ".webp"))] or files
 
     def reader(fp):
+        import io as _io
+
         from PIL import Image
-        with Image.open(fp) as im:
+
+        from .fsutil import resolve_fs
+        fsys, rel = resolve_fs(fp)
+        with fsys.open_input_stream(rel) as f:
+            raw = f.read()
+        with Image.open(_io.BytesIO(raw)) as im:
             if mode:
                 im = im.convert(mode)
             if size is not None:
@@ -49,7 +56,7 @@ def read_images(paths, *, size: Optional[tuple] = None, mode: str = "RGB",
             arr = np.asarray(im)
         cols = {"image": arr[None]}  # [1, H, W, C] tensor column
         if include_paths:
-            cols["path"] = [fp]
+            cols["path"] = [str(fp)]
         return B.block_from_numpy_dict(cols)
 
     return _source_ds([(lambda f=f: reader(f)) for f in files], "read_images")

@@ -286,8 +286,8 @@ def flash_attention(
     v: jax.Array,  # [B, S, Kh, D]
     causal: bool = True,
     scale: Optional[float] = None,
-    block_q: int = 1024,
-    block_kv: int = 1024,
+    block_q: Optional[int] = None,
+    block_kv: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention in [B, T, H, D] layout (matches `mha_reference`).
@@ -299,6 +299,11 @@ def flash_attention(
     """
     from ray_tpu.ops.attention import mha_reference
 
+    # block sizes: explicit arg > env override (perf sweeps) > default 1024
+    if block_q is None:
+        block_q = int(os.environ.get("RAY_TPU_FLASH_BLOCK_Q", 1024))
+    if block_kv is None:
+        block_kv = int(os.environ.get("RAY_TPU_FLASH_BLOCK_KV", 1024))
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     tq, tk = q.shape[1], k.shape[1]
