@@ -32,32 +32,41 @@ VARIANTS = [
 ]
 
 
-def run_variant(name, overrides, timeout):
+def run_variant(name, overrides, timeout, deadline, retries=2):
+    """One measure child per variant, guarded by bench.py's init watchdog —
+    a wedged TPU relay dies at ~120s instead of eating the full timeout
+    (the exact r4 failure mode). Retries ONLY on init_hang (a deterministic
+    failure fails identically every attempt), and every attempt's timeout
+    is clamped to the GLOBAL deadline so retries can't overshoot it."""
+    sys.path.insert(0, REPO)
+    import bench
+
     env = dict(os.environ)
     env.update(overrides)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     t0 = time.time()
-    try:
-        r = subprocess.run(
+    last = None
+    for attempt in range(retries + 1):
+        tmo = min(timeout, deadline - time.time())
+        if tmo < 150:   # not enough room for watchdog + compile
+            return last or {"name": name, "error": "budget"}
+        rc, out, err, reason = bench._popen_watched(
             [sys.executable, os.path.join(REPO, "bench.py"), "--measure",
-             "--config", "llama_1b"],
-            env=env, capture_output=True, text=True, timeout=timeout)
-    except subprocess.TimeoutExpired:
-        return {"name": name, "error": "timeout"}
-    rec = None
-    for line in reversed(r.stdout.strip().splitlines()):
-        try:
-            rec = json.loads(line)
+             "--config", "llama_1b"], env, timeout=tmo)
+        rec = bench._parse_json_tail(out)
+        if rc == 0 and rec is not None:
+            return {"name": name, "mfu": rec.get("mfu"),
+                    "tps_chip": rec.get("value"),
+                    "ms_per_step": rec.get("ms_per_step"),
+                    "batch": rec.get("batch"),
+                    "dt_s": round(time.time() - t0, 1),
+                    "attempt": attempt}
+        last = {"name": name, "error": reason or f"rc={rc}",
+                "tail": (err or "")[-400:]}
+        if reason != "init_hang":
             break
-        except json.JSONDecodeError:
-            continue
-    if r.returncode != 0 or rec is None:
-        return {"name": name, "error": f"rc={r.returncode}",
-                "tail": r.stderr[-500:]}
-    return {"name": name, "mfu": rec.get("mfu"),
-            "tps_chip": rec.get("value"),
-            "ms_per_step": rec.get("ms_per_step"),
-            "batch": rec.get("batch"), "dt_s": round(time.time() - t0, 1)}
+        time.sleep(20)   # give the relay a beat before retrying
+    return last
 
 
 def main():
@@ -68,10 +77,10 @@ def main():
     deadline = time.time() + args.budget_s
     results = []
     for name, overrides in VARIANTS:
-        if time.time() + args.per_run_timeout > deadline:
+        if time.time() + 150 > deadline:
             print(f"# budget exhausted, skipping {name}", file=sys.stderr)
             continue
-        out = run_variant(name, overrides, args.per_run_timeout)
+        out = run_variant(name, overrides, args.per_run_timeout, deadline)
         results.append(out)
         print(json.dumps(out), flush=True)
     good = [r for r in results if r.get("mfu")]

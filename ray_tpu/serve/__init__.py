@@ -12,6 +12,7 @@ from .batching import batch
 from .deployment import AutoscalingConfig, Deployment, DeploymentConfig, deployment
 from .handle import DeploymentHandle, DeploymentResponse
 from .multiplex import get_multiplexed_model_id, multiplexed
+from .pd import DecodeServer, PDServer, PrefillServer
 from .proxy import Request, Response
 from .schema import build_app_config, deploy_config
 
@@ -21,5 +22,5 @@ __all__ = [
     "delete", "deploy_config", "deployment", "get_deployment_handle",
     "grpc_port",
     "get_multiplexed_model_id", "ingress", "multiplexed", "run", "shutdown",
-    "start", "status",
+    "start", "status", "PrefillServer", "DecodeServer", "PDServer",
 ]

@@ -283,54 +283,9 @@ class LLMServer:
                      top_p: Optional[float] = None,
                      top_k: Optional[int] = None,
                      logprobs: bool = False) -> _Slot:
-        import jax.numpy as jnp
-
         P = len(prompt_ids)
-        if P + max_tokens > self.config.max_seq_len:
-            raise ValueError(
-                f"prompt({P}) + max_tokens({max_tokens}) exceeds "
-                f"max_seq_len({self.config.max_seq_len})")
-        mgr = self.page_mgr
-        if mgr is not None:
-            need = -(-(P + max_tokens) // mgr.page_size)
-            if need > min(mgr.num_pages - 1, mgr.max_pages_per_seq):
-                raise ValueError(
-                    f"request needs {need} KV pages but the pool can never "
-                    f"hold more than {min(mgr.num_pages - 1, mgr.max_pages_per_seq)} "
-                    f"per sequence (num_pages={mgr.num_pages}, "
-                    f"page_size={mgr.page_size})")
-        while not self._free or (mgr is not None
-                                 and not mgr.can_fit_prompt(
-                                     list(prompt_ids), P + max_tokens)):
-            # a free slot AND enough free pages (vLLM-style admission:
-            # reserve the full request up front, so decode never OOMs).
-            # Event-driven: _release_slot wakes every waiter; re-check.
-            self._capacity_event.clear()
-            await self._capacity_event.wait()
-        slot_idx = self._free.pop()
-        self._req_counter += 1
-        cached = 0
-        try:
-            if mgr is not None:
-                if self.config.prefix_cache:
-                    row, cached = mgr.allocate_prefix(
-                        slot_idx, list(prompt_ids), P + max_tokens)
-                else:
-                    row = mgr.allocate(slot_idx, P + max_tokens)
-                # lengths[slot] must point PAST the shared prefix before the
-                # next decode tick: write_layer_tokens writes every row at
-                # its length each tick, and a 0 here would land garbage KV
-                # at position 0 of a SHARED page — corrupting the cached
-                # prefix for every borrower. At `cached` the stray write
-                # hits the first FRESH page and prefill chunk 1 overwrites
-                # it (same contract as the uncached pos-0 write).
-                self.cache = self.cache.replace(
-                    block_tables=self.cache.block_tables.at[slot_idx].set(
-                        jnp.asarray(row, jnp.int32)),
-                    lengths=self.cache.lengths.at[slot_idx].set(cached))
-        except BaseException:
-            self._release_slot(slot_idx)
-            raise
+        # feasibility (max_seq_len, page-pool capacity) raises in _reserve
+        slot_idx, cached = await self._reserve(prompt_ids, P + max_tokens)
         cfg = self.config
         slot = _Slot(request_id=self._req_counter, prompt_len=P,
                      max_tokens=max_tokens, generated=[],
@@ -354,6 +309,71 @@ class LLMServer:
         if slot.error is not None:
             raise RuntimeError("prefill failed") from slot.error
         return slot
+
+    async def _reserve(self, prompt_ids, total_len: int,
+                       use_prefix: bool = True):
+        """Wait for a free slot AND enough free pages (vLLM-style admission:
+        reserve the full request up front, so decode never OOMs), then
+        allocate. Event-driven: _release_slot wakes every waiter; re-check.
+        Returns (slot_idx, cached_prefix_tokens)."""
+        import jax.numpy as jnp
+
+        if total_len > self.config.max_seq_len:
+            raise ValueError(
+                f"request needs {total_len} tokens but max_seq_len is "
+                f"{self.config.max_seq_len}")
+        mgr = self.page_mgr
+        if mgr is not None:
+            need = -(-total_len // mgr.page_size)
+            if need > min(mgr.num_pages - 1, mgr.max_pages_per_seq):
+                # infeasible FOREVER — raise rather than wait on capacity
+                # that can never exist (r5 review: PD callers hung here)
+                raise ValueError(
+                    f"request needs {need} KV pages but the pool can never "
+                    f"hold more than "
+                    f"{min(mgr.num_pages - 1, mgr.max_pages_per_seq)} "
+                    f"per sequence (num_pages={mgr.num_pages}, "
+                    f"page_size={mgr.page_size})")
+
+        def fits():
+            if mgr is None:
+                return True
+            # the wait condition must mirror the allocator it gates:
+            # prefix-crediting admission for allocate_prefix, the full page
+            # bill for plain allocate (r5 review: a prefix-credited wait
+            # followed by a full-bill allocate raised MemoryError mid-flight)
+            if use_prefix and self.config.prefix_cache:
+                return mgr.can_fit_prompt(list(prompt_ids), total_len)
+            return mgr.can_fit(total_len)
+
+        while not self._free or not fits():
+            self._capacity_event.clear()
+            await self._capacity_event.wait()
+        slot_idx = self._free.pop()
+        self._req_counter += 1
+        cached = 0
+        try:
+            if mgr is not None:
+                if use_prefix and self.config.prefix_cache:
+                    row, cached = mgr.allocate_prefix(
+                        slot_idx, list(prompt_ids), total_len)
+                else:
+                    row = mgr.allocate(slot_idx, total_len)
+                # lengths[slot] must point PAST the shared prefix before the
+                # next decode tick: write_layer_tokens writes every row at
+                # its length each tick, and a 0 here would land garbage KV
+                # at position 0 of a SHARED page — corrupting the cached
+                # prefix for every borrower. At `cached` the stray write
+                # hits the first FRESH page and prefill chunk 1 overwrites
+                # it (same contract as the uncached pos-0 write).
+                self.cache = self.cache.replace(
+                    block_tables=self.cache.block_tables.at[slot_idx].set(
+                        jnp.asarray(row, jnp.int32)),
+                    lengths=self.cache.lengths.at[slot_idx].set(cached))
+        except BaseException:
+            self._release_slot(slot_idx)
+            raise
+        return slot_idx, cached
 
     def _prefill_chunk(self, job: _PrefillJob):
         """Run ONE chunk of `job`'s prompt; returns final-chunk logits or

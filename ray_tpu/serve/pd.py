@@ -1,0 +1,275 @@
+"""Prefill/Decode disaggregated serving (reference: python/ray/llm/
+_internal/serve/serving_patterns/prefill_decode/pd_server.py).
+
+Decode-as-orchestrator, like the reference: the decode server receives
+the request, asks a PREFILL server to compute the prompt's KV (the
+reference sends a max_tokens=1 request carrying kv_transfer_params and
+lets NIXL move the blocks), installs the returned pages into its own
+paged cache, and runs all decode steps locally. Prefill-heavy and
+decode-heavy load scale independently — the reference's motivation —
+and on this runtime the KV moves through the object store, whose
+node-to-node direct plane (r5) is exactly a KV-transfer fabric.
+
+TPU-first re-cut: paged KV pages ARE the transfer unit. The prefill
+server extracts its slot's pages as [L, Kh, T, D] host arrays; the
+decode server scatters them into freshly allocated pages with one
+device op and resumes at position T. Requires paged=True (the dense
+cache has no page identity to ship).
+"""
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .llm import LLMConfig, LLMServer, _Slot
+
+
+def _require_paged(server: LLMServer, who: str):
+    if server.page_mgr is None:
+        raise ValueError(f"{who} needs LLMConfig(paged=True): KV pages are "
+                         "the prefill→decode transfer unit")
+
+
+class PrefillServer(LLMServer):
+    """Prefill-only replica: computes prompt KV + the first token, ships
+    both, keeps nothing. Scale this deployment for prompt-heavy load."""
+
+    async def prefill_kv(self, prompt_ids: List[int],
+                         temperature: Optional[float] = None,
+                         top_p: Optional[float] = None,
+                         top_k: Optional[int] = None,
+                         logprobs: bool = False) -> Dict[str, Any]:
+        import asyncio
+
+        import jax
+        import jax.numpy as jnp
+
+        from .llm import _PrefillJob
+
+        _require_paged(self, "PrefillServer")
+        cfg = self.config
+        prompt = list(prompt_ids)
+        P = len(prompt)
+        # plain allocation: extraction reads raw pages, prefix-sharing
+        # bookkeeping would complicate ownership for zero benefit here.
+        # Feasibility (max_seq_len / pool capacity) raises in _reserve.
+        slot_idx, _ = await self._reserve(prompt, P, use_prefix=False)
+        try:
+            job = _PrefillJob(slot_idx=slot_idx, slot=None,
+                              prompt=np.asarray(prompt, np.int32))
+            last_logits = None
+            while last_logits is None:
+                last_logits = self._prefill_chunk(job)
+                await asyncio.sleep(0)   # stay responsive between chunks
+            self._sample_key, sub = jax.random.split(self._sample_key)
+            first, flogp = self._sample_first(
+                last_logits, sub,
+                jnp.float32(cfg.temperature if temperature is None
+                            else temperature),
+                jnp.float32(cfg.top_p if top_p is None else top_p),
+                jnp.int32(cfg.top_k if top_k is None else top_k),
+                logprobs)
+            k, v = self._extract_kv(slot_idx, P)
+        finally:
+            self._release_slot(slot_idx)
+        out = {"k": k, "v": v, "prompt_len": P, "token": int(first)}
+        if logprobs:
+            out["logprob"] = float(flogp)
+        return out
+
+    def _extract_kv(self, slot_idx: int, P: int):
+        """Slot pages → contiguous [L, Kh, P, D] host arrays."""
+        import jax
+
+        ps = self.config.page_size
+        n = -(-P // ps)
+        rows = np.asarray(jax.device_get(
+            self.cache.block_tables[slot_idx]))[:n]
+        k = np.asarray(jax.device_get(self.cache.k_pages[:, :, rows]))
+        v = np.asarray(jax.device_get(self.cache.v_pages[:, :, rows]))
+        L, Kh, _n, pg, D = k.shape
+        k = k.reshape(L, Kh, _n * pg, D)[:, :, :P]
+        v = v.reshape(L, Kh, _n * pg, D)[:, :, :P]
+        return k, v
+
+
+class DecodeServer(LLMServer):
+    """Decode replica that can admit a request whose prompt KV was computed
+    elsewhere: install pages, skip prefill entirely, decode as usual."""
+
+    async def _admit_with_kv(self, prompt: List[int], kv: Dict[str, Any],
+                             max_tokens: int, eos_id, stream: bool,
+                             temperature, top_p, top_k, logprobs):
+        """Install shipped KV into a reserved slot and hand the request to
+        the decode tick loop; returns (slot_idx, slot, finished_early)."""
+        import asyncio
+
+        _require_paged(self, "DecodeServer")
+        cfg = self.config
+        P = len(prompt)
+        if kv["prompt_len"] != P:
+            raise ValueError("kv prompt_len does not match prompt")
+        slot_idx, _ = await self._reserve(prompt, P + max_tokens,
+                                          use_prefix=False)
+        try:
+            self._install_kv(slot_idx, kv["k"], kv["v"], P)
+        except BaseException:
+            self._release_slot(slot_idx)
+            raise
+        first = int(kv["token"])
+        slot = _Slot(request_id=self._req_counter, prompt_len=P,
+                     max_tokens=max_tokens, generated=[first],
+                     done_event=asyncio.Event(),
+                     stream_queue=asyncio.Queue() if stream else None,
+                     eos_id=eos_id,
+                     temperature=(cfg.temperature if temperature is None
+                                  else temperature),
+                     top_p=cfg.top_p if top_p is None else top_p,
+                     top_k=cfg.top_k if top_k is None else top_k,
+                     want_logprobs=logprobs)
+        if logprobs and "logprob" in kv:
+            slot.logprobs.append(float(kv["logprob"]))
+        if slot.stream_queue is not None:
+            slot.stream_queue.put_nowait(first)
+        slot.first_token.set()
+        finished = max_tokens <= 1 or (eos_id is not None and first == eos_id)
+        if finished:
+            self._release_slot(slot_idx)
+            slot.done_event.set()
+            if slot.stream_queue is not None:
+                slot.stream_queue.put_nowait(None)
+        else:
+            self._active[slot_idx] = slot
+            self._ensure_tick_loop()
+        return slot_idx, slot, finished
+
+    async def generate_with_kv(self, prompt_ids: List[int],
+                               kv: Dict[str, Any], max_tokens: int = 32,
+                               eos_id: Optional[int] = None,
+                               temperature: Optional[float] = None,
+                               top_p: Optional[float] = None,
+                               top_k: Optional[int] = None,
+                               logprobs: bool = False) -> Dict[str, Any]:
+        t0 = time.perf_counter()
+        prompt = list(prompt_ids)
+        _idx, slot, finished = await self._admit_with_kv(
+            prompt, kv, max_tokens, eos_id, False, temperature, top_p,
+            top_k, logprobs)
+        ttft = time.perf_counter() - t0
+        if not finished:
+            await slot.done_event.wait()
+            if slot.error is not None:
+                raise RuntimeError("decode engine failed") from slot.error
+        toks = slot.generated[:max_tokens]
+        if eos_id is not None and eos_id in toks:
+            toks = toks[:toks.index(eos_id)]
+        out = {"tokens": toks, "ttft_s": ttft,
+               "total_s": time.perf_counter() - t0}
+        if logprobs:
+            out["logprobs"] = slot.logprobs[:len(toks)]
+        return out
+
+    def _install_kv(self, slot_idx: int, k, v, P: int) -> None:
+        """Scatter [L, Kh, P, D] host KV into this slot's allocated pages
+        (one device op per pool)."""
+        import jax
+        import jax.numpy as jnp
+
+        ps = self.config.page_size
+        n = -(-P // ps)
+        pad = n * ps - P
+        L, Kh, _p, D = np.shape(k)
+        rows = np.asarray(jax.device_get(
+            self.cache.block_tables[slot_idx]))[:n]
+        dtype = self.cache.k_pages.dtype
+
+        def to_pages(x):
+            x = np.asarray(x)
+            if pad:
+                x = np.concatenate(
+                    [x, np.zeros((L, Kh, pad, D), x.dtype)], axis=2)
+            return jnp.asarray(x.reshape(L, Kh, n, ps, D), dtype)
+
+        self.cache = self.cache.replace(
+            k_pages=self.cache.k_pages.at[:, :, rows].set(to_pages(k)),
+            v_pages=self.cache.v_pages.at[:, :, rows].set(to_pages(v)),
+            lengths=self.cache.lengths.at[slot_idx].set(P))
+
+
+class PDServer(DecodeServer):
+    """Decode-as-orchestrator deployment (ref pd_server.py PDOrchestrator):
+    holds the prefill deployment's handle; every generate() round-trips the
+    prompt through remote prefill and decodes locally. `prefill` may be a
+    serve DeploymentHandle or a direct PrefillServer (in-process tests)."""
+
+    def __init__(self, config: Optional[LLMConfig] = None, params=None,
+                 prefill=None):
+        super().__init__(config, params)
+        _require_paged(self, "PDServer")
+        self._prefill = prefill
+        self.pd_requests = 0
+
+    async def _remote_prefill(self, prompt: List[int], **kw):
+        if isinstance(self._prefill, PrefillServer):
+            return await self._prefill.prefill_kv(prompt, **kw)
+        # serve DeploymentHandle: .remote() does sync controller IO (keep it
+        # off the loop); the DeploymentResponse itself is awaitable
+        import asyncio
+        loop = asyncio.get_running_loop()
+        resp = await loop.run_in_executor(
+            None, lambda: self._prefill.prefill_kv.remote(prompt, **kw))
+        return await resp
+
+    async def generate(self, prompt_ids: List[int], max_tokens: int = 32,
+                       eos_id: Optional[int] = None,
+                       temperature: Optional[float] = None,
+                       top_p: Optional[float] = None,
+                       top_k: Optional[int] = None,
+                       logprobs: bool = False) -> Dict[str, Any]:
+        if self._prefill is None:   # degraded mode: colocated prefill
+            return await super().generate(
+                prompt_ids, max_tokens, eos_id, temperature=temperature,
+                top_p=top_p, top_k=top_k, logprobs=logprobs)
+        self.pd_requests += 1
+        kw = dict(temperature=temperature, top_p=top_p, top_k=top_k,
+                  logprobs=logprobs)
+        kv = await self._remote_prefill(list(prompt_ids), **kw)
+        return await self.generate_with_kv(
+            list(prompt_ids), kv, max_tokens, eos_id, **kw)
+
+    async def generate_stream(self, prompt_ids: List[int],
+                              max_tokens: int = 32,
+                              eos_id: Optional[int] = None,
+                              temperature: Optional[float] = None,
+                              top_p: Optional[float] = None,
+                              top_k: Optional[int] = None):
+        """Streaming rides the same disaggregation: remote prefill, then
+        tokens stream from the local decode slot (the inherited path would
+        silently prefill on THIS replica — r5 review)."""
+        if self._prefill is None:
+            async for tok in super().generate_stream(
+                    prompt_ids, max_tokens, eos_id, temperature=temperature,
+                    top_p=top_p, top_k=top_k):
+                yield tok
+            return
+        self.pd_requests += 1
+        kw = dict(temperature=temperature, top_p=top_p, top_k=top_k)
+        kv = await self._remote_prefill(list(prompt_ids), **kw)
+        _idx, slot, _fin = await self._admit_with_kv(
+            list(prompt_ids), kv, max_tokens, eos_id, True,
+            temperature, top_p, top_k, False)
+        emitted = 0
+        while emitted < max_tokens:
+            tok = await slot.stream_queue.get()
+            if tok is None or (eos_id is not None and tok == eos_id):
+                break
+            emitted += 1
+            yield tok
+        if slot.error is not None:
+            raise RuntimeError("decode engine failed") from slot.error
+
+    def stats(self) -> Dict[str, Any]:
+        s = super().stats()
+        s["pd_requests"] = self.pd_requests
+        return s
