@@ -63,7 +63,7 @@ def run_variant(name, overrides, timeout, deadline, retries=2):
                     "attempt": attempt}
         last = {"name": name, "error": reason or f"rc={rc}",
                 "tail": (err or "")[-400:]}
-        if reason != "init_hang":
+        if reason != "init_hang" or attempt == retries:
             break
         time.sleep(20)   # give the relay a beat before retrying
     return last

@@ -277,17 +277,14 @@ class LLMServer:
         return min(b, self.config.max_seq_len)
 
     # -- request admission ---------------------------------------------------
-    async def _admit(self, prompt_ids: List[int], max_tokens: int,
-                     eos_id: Optional[int], stream: bool,
-                     temperature: Optional[float] = None,
-                     top_p: Optional[float] = None,
-                     top_k: Optional[int] = None,
-                     logprobs: bool = False) -> _Slot:
-        P = len(prompt_ids)
-        # feasibility (max_seq_len, page-pool capacity) raises in _reserve
-        slot_idx, cached = await self._reserve(prompt_ids, P + max_tokens)
+    def _make_slot(self, prompt_len: int, max_tokens: int,
+                   eos_id: Optional[int], stream: bool, temperature,
+                   top_p, top_k, logprobs: bool) -> _Slot:
+        """Single site for per-request state + sampling-default fallbacks —
+        shared with the PD decode path (pd.py) so a new sampling knob can't
+        silently diverge between colocated and disaggregated admission."""
         cfg = self.config
-        slot = _Slot(request_id=self._req_counter, prompt_len=P,
+        return _Slot(request_id=self._req_counter, prompt_len=prompt_len,
                      max_tokens=max_tokens, generated=[],
                      done_event=asyncio.Event(),
                      stream_queue=asyncio.Queue() if stream else None,
@@ -297,6 +294,18 @@ class LLMServer:
                      top_p=cfg.top_p if top_p is None else top_p,
                      top_k=cfg.top_k if top_k is None else top_k,
                      want_logprobs=logprobs)
+
+    async def _admit(self, prompt_ids: List[int], max_tokens: int,
+                     eos_id: Optional[int], stream: bool,
+                     temperature: Optional[float] = None,
+                     top_p: Optional[float] = None,
+                     top_k: Optional[int] = None,
+                     logprobs: bool = False) -> _Slot:
+        P = len(prompt_ids)
+        # feasibility (max_seq_len, page-pool capacity) raises in _reserve
+        slot_idx, cached = await self._reserve(prompt_ids, P + max_tokens)
+        slot = self._make_slot(P, max_tokens, eos_id, stream, temperature,
+                               top_p, top_k, logprobs)
         # the engine feeds the prompt through in chunks, interleaved with
         # decode ticks for already-active slots (chunked prefill). A cached
         # prefix starts the job past the shared pages — their KV is already

@@ -22,7 +22,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-from .llm import LLMConfig, LLMServer, _Slot
+from .llm import LLMConfig, LLMServer
 
 
 def _require_paged(server: LLMServer, who: str):
@@ -106,7 +106,6 @@ class DecodeServer(LLMServer):
         import asyncio
 
         _require_paged(self, "DecodeServer")
-        cfg = self.config
         P = len(prompt)
         if kv["prompt_len"] != P:
             raise ValueError("kv prompt_len does not match prompt")
@@ -118,16 +117,9 @@ class DecodeServer(LLMServer):
             self._release_slot(slot_idx)
             raise
         first = int(kv["token"])
-        slot = _Slot(request_id=self._req_counter, prompt_len=P,
-                     max_tokens=max_tokens, generated=[first],
-                     done_event=asyncio.Event(),
-                     stream_queue=asyncio.Queue() if stream else None,
-                     eos_id=eos_id,
-                     temperature=(cfg.temperature if temperature is None
-                                  else temperature),
-                     top_p=cfg.top_p if top_p is None else top_p,
-                     top_k=cfg.top_k if top_k is None else top_k,
-                     want_logprobs=logprobs)
+        slot = self._make_slot(P, max_tokens, eos_id, stream, temperature,
+                               top_p, top_k, logprobs)
+        slot.generated.append(first)
         if logprobs and "logprob" in kv:
             slot.logprobs.append(float(kv["logprob"]))
         if slot.stream_queue is not None:
@@ -171,8 +163,13 @@ class DecodeServer(LLMServer):
         return out
 
     def _install_kv(self, slot_idx: int, k, v, P: int) -> None:
-        """Scatter [L, Kh, P, D] host KV into this slot's allocated pages
-        (one device op per pool)."""
+        """Scatter [L, Kh, P, D] host KV into this slot's allocated pages.
+
+        The scatter runs jitted with the pools DONATED, so XLA updates the
+        page arrays in place — an un-jitted `.at[].set` here would copy
+        both full pools per admitted request (a transient 2x-KV-pool HBM
+        spike on the hot path; r5 review). One compile per page-count `n`,
+        the same bucketing cost profile as chunked prefill."""
         import jax
         import jax.numpy as jnp
 
@@ -191,10 +188,18 @@ class DecodeServer(LLMServer):
                     [x, np.zeros((L, Kh, pad, D), x.dtype)], axis=2)
             return jnp.asarray(x.reshape(L, Kh, n, ps, D), dtype)
 
-        self.cache = self.cache.replace(
-            k_pages=self.cache.k_pages.at[:, :, rows].set(to_pages(k)),
-            v_pages=self.cache.v_pages.at[:, :, rows].set(to_pages(v)),
-            lengths=self.cache.lengths.at[slot_idx].set(P))
+        if getattr(self, "_install_jit", None) is None:
+            def install(kp, vp, lengths, knew, vnew, rows, slot, plen):
+                return (kp.at[:, :, rows].set(knew),
+                        vp.at[:, :, rows].set(vnew),
+                        lengths.at[slot].set(plen))
+            self._install_jit = jax.jit(install, donate_argnums=(0, 1, 2))
+        kp, vp, lengths = self._install_jit(
+            self.cache.k_pages, self.cache.v_pages, self.cache.lengths,
+            to_pages(k), to_pages(v), jnp.asarray(rows),
+            jnp.int32(slot_idx), jnp.int32(P))
+        self.cache = self.cache.replace(k_pages=kp, v_pages=vp,
+                                        lengths=lengths)
 
 
 class PDServer(DecodeServer):

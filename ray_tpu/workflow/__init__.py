@@ -15,9 +15,20 @@ a static FunctionNode DAG executed step-by-step, each step's result
 pickled into the per-user scratch root before its dependents run. Resume
 replays the journal: completed steps load from storage, everything else
 re-executes. Exactly-once is per-step at-least-once with idempotent
-journaling — the reference's model. Dynamic continuations
-(workflow.continuation) are not implemented; virtual actors are subsumed
-by detached actors + GCS journaling (_private/gcs.py).
+journaling — the reference's model. Dynamic continuations are supported:
+a step that returns `workflow.continuation(sub_dag)` tail-calls the
+sub-DAG — the engine journals the continuation itself (so resume never
+re-runs the step that produced it) and recursively executes the sub-DAG's
+steps under namespaced journal keys, enabling recursion/loops whose shape
+is decided at runtime. Virtual actors are subsumed by detached actors +
+GCS journaling (_private/gcs.py).
+
+NOTE on reference parity: the reference REMOVED ray.workflow in 2.44
+(/root/reference/python/ray/workflow/__init__.py is a deprecation stub
+raising RuntimeError). This module re-implements the pre-removal surface
+(run/run_async/resume/get_status/list_all/delete + continuation) because
+SURVEY §2 carries it; ours is therefore a superset of what the reference
+currently ships.
 
 Step identity: the DAG's deterministic topological index + function name —
 stable across runs of the same code, no user-supplied step ids needed
@@ -68,10 +79,147 @@ def _step_key(idx: int, node: FunctionNode) -> str:
     return f"step_{idx:04d}_{node.name}"
 
 
+def _fs_key(logical_key: str) -> str:
+    """Map a logical step key to a filename-safe journal key. Deep
+    continuation chains grow the prefix linearly (each tail-call appends
+    '<step>.c.'), which would blow the 255-byte filename limit around
+    depth ~10 — long keys collapse to a stable digest of the full logical
+    key, so identity (and therefore resume) is preserved at any depth."""
+    if len(logical_key) <= 150:
+        return logical_key
+    import hashlib
+    digest = hashlib.sha256(logical_key.encode()).hexdigest()[:32]
+    return f"{logical_key[:80]}...h{digest}"
+
+
 class _Status:
     RUNNING = "RUNNING"
     SUCCESSFUL = "SUCCESSFUL"
     FAILED = "FAILED"
+
+
+class Continuation:
+    """Wrapper a step returns to tail-call another DAG (see `continuation`)."""
+
+    __slots__ = ("dag",)
+
+    def __init__(self, dag: FunctionNode):
+        self.dag = dag
+
+
+def continuation(dag: FunctionNode) -> Continuation:
+    """Tail-call `dag` as the rest of this step's computation.
+
+    Return `workflow.continuation(fn.bind(...))` from inside a workflow
+    step and the engine executes the bound sub-DAG as this step's
+    replacement: the step's journaled value becomes the sub-DAG's value,
+    the sub-DAG's own steps are durably journaled (namespaced under the
+    producing step's key), and a crash anywhere resumes without re-running
+    the step that produced the continuation. Continuations may nest
+    (a sub-step may itself return one), which is how runtime-shaped
+    loops/recursion are expressed:
+
+        @ray_tpu.remote
+        def fac(n, acc=1):
+            if n <= 1:
+                return acc
+            return workflow.continuation(fac.bind(n - 1, acc * n))
+
+        workflow.run(fac.bind(5))   # -> 120
+    """
+    if not isinstance(dag, FunctionNode):
+        raise TypeError("continuation takes a task DAG built with "
+                        "fn.bind(...)")
+    return Continuation(dag)
+
+
+class _TailCall:
+    """Internal: a DAG level's TERMINAL step produced a Continuation. The
+    trampoline in `_exec_dag` follows it iteratively — a 10k-deep
+    tail-recursive workflow must not consume 10k Python stack frames."""
+
+    __slots__ = ("key", "dag")
+
+    def __init__(self, key: str, dag: FunctionNode):
+        self.key = key
+        self.dag = dag
+
+
+def _journal(path: str, obj: Any, *, code: bool = False) -> None:
+    """Atomic write; `code=True` uses cloudpickle (continuation DAGs carry
+    functions)."""
+    import cloudpickle
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        (cloudpickle if code else pickle).dump(obj, f)
+    os.replace(tmp, path)
+
+
+def _exec_dag(dag: FunctionNode, wdir: str, prefix: str = "") -> Any:
+    """Execute a DAG durably, resolving continuations.
+
+    Tail-calls (the level's LAST step returns a Continuation) are followed
+    by an iterative trampoline: the continuation DAG is journaled
+    (<key>.cont.pkl) and becomes the next loop iteration, so chain depth
+    costs zero stack. Mid-DAG continuations (a non-terminal step returns
+    one) recurse — that depth is the user's DAG nesting, not the chain
+    length. When the chain bottoms out, the final value is journaled into
+    every pending tail-call key's <key>.pkl (unwound in reverse) so
+    dependents, re-runs, and `resume(wid)`'s terminal-value lookup all
+    observe fully-resolved values."""
+    pending: List[str] = []  # tail-call keys awaiting the chain's value
+    while True:
+        res = _exec_steps(dag, wdir, prefix)
+        if isinstance(res, _TailCall):
+            pending.append(res.key)
+            dag, prefix = res.dag, res.key + ".c."
+            continue
+        break
+    for key in reversed(pending):
+        _journal(os.path.join(wdir, key + ".pkl"), res)
+    return res
+
+
+def _exec_steps(dag: FunctionNode, wdir: str, prefix: str):
+    """Run one DAG level; returns the terminal value, or a _TailCall if the
+    terminal step produced a Continuation (journaled before returning)."""
+    import cloudpickle
+
+    import ray_tpu
+
+    order = _toposort(dag)
+    values: Dict[int, Any] = {}
+    for idx, node in enumerate(order):
+        terminal = idx == len(order) - 1
+        key = _fs_key(prefix + _step_key(idx, node))
+        path = os.path.join(wdir, key + ".pkl")
+        cont_path = os.path.join(wdir, key + ".cont.pkl")
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                values[id(node)] = pickle.load(f)
+            continue
+        if os.path.exists(cont_path):
+            # crashed mid-continuation: resume the journaled sub-DAG
+            # WITHOUT re-running the step that produced it
+            with open(cont_path, "rb") as f:
+                sub = cloudpickle.load(f)
+            if terminal:
+                return _TailCall(key, sub)
+            value = _exec_dag(sub, wdir, prefix=key + ".c.")
+        else:
+            args = tuple(values[id(a)] if isinstance(a, FunctionNode) else a
+                         for a in node.args)
+            kwargs = {k: values[id(v)] if isinstance(v, FunctionNode) else v
+                      for k, v in node.kwargs.items()}
+            value = ray_tpu.get(node.remote_fn.remote(*args, **kwargs))
+            if isinstance(value, Continuation):
+                _journal(cont_path, value.dag, code=True)
+                if terminal:
+                    return _TailCall(key, value.dag)
+                value = _exec_dag(value.dag, wdir, prefix=key + ".c.")
+        _journal(path, value)  # journal BEFORE dependents observe it
+        values[id(node)] = value
+    return values[id(order[-1])]
 
 
 def run(dag: FunctionNode, *, workflow_id: Optional[str] = None) -> Any:
@@ -87,30 +235,11 @@ def run(dag: FunctionNode, *, workflow_id: Optional[str] = None) -> Any:
     os.makedirs(wdir, exist_ok=True)
     _write_meta(wdir, {"status": _Status.RUNNING, "started_at": time.time()})
 
-    order = _toposort(dag)
-    values: Dict[int, Any] = {}
     try:
-        for idx, node in enumerate(order):
-            key = _step_key(idx, node)
-            path = os.path.join(wdir, key + ".pkl")
-            if os.path.exists(path):
-                with open(path, "rb") as f:
-                    values[id(node)] = pickle.load(f)
-                continue
-            args = tuple(values[id(a)] if isinstance(a, FunctionNode) else a
-                         for a in node.args)
-            kwargs = {k: values[id(v)] if isinstance(v, FunctionNode) else v
-                      for k, v in node.kwargs.items()}
-            value = ray_tpu.get(node.remote_fn.remote(*args, **kwargs))
-            tmp = path + ".tmp"
-            with open(tmp, "wb") as f:
-                pickle.dump(value, f)
-            os.replace(tmp, path)  # journal BEFORE dependents observe it
-            values[id(node)] = value
+        out = _exec_dag(dag, wdir)
     except BaseException as e:
         _write_meta(wdir, {"status": _Status.FAILED, "error": repr(e)})
         raise
-    out = values[id(order[-1])]
     _write_meta(wdir, {"status": _Status.SUCCESSFUL,
                        "finished_at": time.time()})
     return out
@@ -189,4 +318,5 @@ def _read_meta(wdir: str) -> Dict:
         return {}
 
 
-__all__ = ["run", "run_async", "resume", "get_status", "list_all", "delete"]
+__all__ = ["run", "run_async", "resume", "get_status", "list_all", "delete",
+           "continuation", "Continuation"]

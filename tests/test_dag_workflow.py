@@ -161,3 +161,105 @@ def test_workflow_failure_then_resume_skips_done_steps(ray_session, tmp_path):
     assert loads.read_text().count("produce") == 1
     assert workflow.get_status(wid) == "SUCCESSFUL"
     workflow.delete(wid)
+
+
+def test_workflow_continuation_recursion(ray_session):
+    """A step returning workflow.continuation(sub_dag) tail-calls it; deep
+    tail-recursion works because long journal keys collapse to digests."""
+    ray = ray_session
+    from ray_tpu import workflow
+
+    @ray.remote
+    def fac(n, acc=1):
+        if n <= 1:
+            return acc
+        return workflow.continuation(fac.bind(n - 1, acc * n))
+
+    wid = f"wf_cont_{time.time_ns()}"
+    assert workflow.run(fac.bind(12), workflow_id=wid) == 479001600
+    assert workflow.get_status(wid) == "SUCCESSFUL"
+    # finished workflow answers resume() without a DAG (terminal = root step)
+    assert workflow.resume(wid) == 479001600
+    workflow.delete(wid)
+
+
+def test_workflow_continuation_deep_chain(ray_session):
+    """Tail-call chains are trampolined, not recursed: a 1200-deep chain
+    (well past Python's default 1000 recursion limit) completes."""
+    ray = ray_session
+    from ray_tpu import workflow
+
+    @ray.remote
+    def count(n, acc=0):
+        if n == 0:
+            return acc
+        return workflow.continuation(count.bind(n - 1, acc + 1))
+
+    wid = f"wf_deep_{time.time_ns()}"
+    assert workflow.run(count.bind(1200), workflow_id=wid) == 1200
+    assert workflow.resume(wid) == 1200
+    workflow.delete(wid)
+
+
+def test_workflow_continuation_resume_skips_parent(ray_session, tmp_path):
+    """Crash INSIDE a continuation: resume must not re-run the step that
+    produced it (the continuation DAG itself is journaled)."""
+    ray = ray_session
+    from ray_tpu import workflow
+
+    marker = tmp_path / "fail_once"
+    marker.write_text("x")
+    calls = tmp_path / "calls.txt"
+
+    @ray.remote
+    def finisher(x):
+        import os
+        with open(calls, "a") as f:
+            f.write("finisher\n")
+        if os.path.exists(marker):
+            raise RuntimeError("transient")
+        return x + 1
+
+    @ray.remote
+    def starter():
+        with open(calls, "a") as f:
+            f.write("starter\n")
+        return workflow.continuation(finisher.bind(41))
+
+    wid = f"wf_cont_fail_{time.time_ns()}"
+    with pytest.raises(Exception):
+        workflow.run(starter.bind(), workflow_id=wid)
+    assert workflow.get_status(wid) == "FAILED"
+
+    marker.unlink()
+    assert workflow.resume(wid, starter.bind()) == 42
+    text = calls.read_text()
+    # starter ran exactly once: the journaled continuation was replayed
+    assert text.count("starter") == 1
+    assert text.count("finisher") == 2
+    workflow.delete(wid)
+
+
+def test_workflow_continuation_mid_dag(ray_session):
+    """A continuation produced by a NON-terminal step resolves before its
+    dependents observe the value."""
+    ray = ray_session
+    from ray_tpu import workflow
+
+    @ray.remote
+    def expand(n):
+        # dynamic shape: decided at runtime, not when the DAG was built
+        return workflow.continuation(tally.bind(list(range(n))))
+
+    @ray.remote
+    def tally(xs):
+        return sum(xs)
+
+    @ray.remote
+    def double(x):
+        return 2 * x
+
+    wid = f"wf_cont_mid_{time.time_ns()}"
+    out = workflow.run(double.bind(expand.bind(5)), workflow_id=wid)
+    assert out == 2 * (0 + 1 + 2 + 3 + 4)
+    workflow.delete(wid)
