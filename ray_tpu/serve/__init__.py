@@ -12,6 +12,7 @@ from .batching import batch
 from .deployment import AutoscalingConfig, Deployment, DeploymentConfig, deployment
 from .handle import DeploymentHandle, DeploymentResponse
 from .multiplex import get_multiplexed_model_id, multiplexed
+from .openai_api import ByteTokenizer, OpenAIIngress, build_openai_app
 from .pd import DecodeServer, PDServer, PrefillServer
 from .proxy import Request, Response
 from .schema import build_app_config, deploy_config
@@ -23,4 +24,5 @@ __all__ = [
     "grpc_port",
     "get_multiplexed_model_id", "ingress", "multiplexed", "run", "shutdown",
     "start", "status", "PrefillServer", "DecodeServer", "PDServer",
+    "ByteTokenizer", "OpenAIIngress", "build_openai_app",
 ]

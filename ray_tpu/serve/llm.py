@@ -55,6 +55,9 @@ class LLMConfig:
     # content-addressed and shared across requests with refcounts — a
     # repeated prompt prefix skips its prefill entirely (TTFT win).
     prefix_cache: bool = True
+    # extra LlamaConfig kwargs applied over the preset (e.g. vocab_size for
+    # a tokenizer whose id space outgrows the preset's)
+    model_overrides: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass
@@ -105,6 +108,8 @@ class LLMServer:
                          param_dtype=getattr(jnp, cfg.param_dtype))
         if cfg.dtype is not None:
             overrides["dtype"] = getattr(jnp, cfg.dtype)
+        if cfg.model_overrides:
+            overrides.update(cfg.model_overrides)
         self.model_cfg = preset(**overrides)
         self.model = Llama(self.model_cfg)
         B = cfg.max_batch_slots
@@ -576,14 +581,21 @@ class LLMServer:
                                  temperature=temperature, top_p=top_p,
                                  top_k=top_k)
         emitted = 0
-        while emitted < max_tokens:
-            tok = await slot.stream_queue.get()
-            if tok is None or (eos_id is not None and tok == eos_id):
-                break
-            emitted += 1
-            yield tok
-        if slot.error is not None:
-            raise RuntimeError("decode engine failed") from slot.error
+        try:
+            while emitted < max_tokens:
+                tok = await slot.stream_queue.get()
+                if tok is None or (eos_id is not None and tok == eos_id):
+                    break
+                emitted += 1
+                yield tok
+            if slot.error is not None:
+                raise RuntimeError("decode engine failed") from slot.error
+        finally:
+            # consumer walked away early (stop string matched, client
+            # disconnected): shrink the budget so the tick loop finishes
+            # and releases this slot next tick instead of decoding — and
+            # holding batch slot + KV pages — all the way to max_tokens
+            slot.max_tokens = min(slot.max_tokens, len(slot.generated))
 
     def stats(self) -> Dict[str, Any]:
         s = {"active": len(self._active), "free_slots": len(self._free),

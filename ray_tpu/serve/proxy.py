@@ -363,6 +363,21 @@ class ProxyActor:
         # errors before the head is written surface as a normal 500
         gen = await loop.run_in_executor(self._pool, handle.remote, req)
         it = iter(gen)
+        _END = object()
+        # the FIRST item decides the wire shape: a Response means the
+        # generator ingress answered this particular request unary (e.g. an
+        # OpenAI endpoint whose body said stream=false) — write it as plain
+        # HTTP, no SSE framing. Fetching it before the head also turns
+        # first-item replica errors into proper 500s instead of a 200 head
+        # followed by an SSE error event.
+        first = await loop.run_in_executor(self._pool, lambda: next(it, _END))
+        if isinstance(first, Response):
+            # _serve_one closes this connection (streaming dispatch is
+            # close-delimited) — say so, or keep-alive clients (OpenAI SDKs
+            # pool connections) reuse the dead socket and hit ECONNRESET
+            first.headers.setdefault("Connection", "close")
+            await self._write_plain(writer, first)
+            return
         writer.write(self._head(200, {
             "Content-Type": "text/event-stream",
             "Cache-Control": "no-cache",
@@ -370,15 +385,15 @@ class ProxyActor:
         await writer.drain()
         # after the 200 head no HTTP error can be signalled — mid-stream
         # replica failures become an SSE error event, never a 500-in-body
-        _END = object()
+        item = first
         try:
             while True:
-                item = await loop.run_in_executor(
-                    self._pool, lambda: next(it, _END))
                 if item is _END:
                     break
                 writer.write(_encode_sse(item))
                 await writer.drain()
+                item = await loop.run_in_executor(
+                    self._pool, lambda: next(it, _END))
             writer.write(b"data: [DONE]\n\n")
         except ConnectionError:
             raise

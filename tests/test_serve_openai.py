@@ -1,0 +1,295 @@
+"""OpenAI-compatible serving surface e2e (reference: python/ray/llm/
+_internal/serve/core/ingress/ingress.py): /v1/models, /v1/completions,
+/v1/chat/completions (unary + SSE stream), /tokenize, /detokenize, and
+OpenAI-shaped error bodies — all over real HTTP through the proxy."""
+
+import http.client
+import json
+
+import pytest
+
+
+def _req(port, method, path, body=None, headers=None):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+    conn.request(method, path, body=body, headers=headers or {})
+    resp = conn.getresponse()
+    data = resp.read()
+    conn.close()
+    return resp.status, dict(resp.getheaders()), data
+
+
+def _sse_events(data: bytes):
+    events = []
+    for block in data.decode().split("\n\n"):
+        for line in block.splitlines():
+            if line.startswith("data: "):
+                events.append(line[len("data: "):])
+    return events
+
+
+@pytest.fixture(scope="module")
+def openai_port(ray_session):
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMConfig
+
+    app = serve.build_openai_app({
+        "tiny-lm": LLMConfig(preset="tiny", max_batch_slots=2,
+                             max_seq_len=128, temperature=0.0,
+                             model_overrides={"vocab_size": 260}),
+    })
+    serve.run(app, name="openai", route_prefix="/")
+    port = serve.start(http_options={"port": 0})
+    yield port
+    serve.shutdown()
+
+
+def test_models_list_and_card(openai_port):
+    status, _h, data = _req(openai_port, "GET", "/v1/models")
+    assert status == 200
+    out = json.loads(data)
+    assert out["object"] == "list"
+    assert [m["id"] for m in out["data"]] == ["tiny-lm"]
+
+    status, _h, data = _req(openai_port, "GET", "/v1/models/tiny-lm")
+    assert status == 200
+    assert json.loads(data)["id"] == "tiny-lm"
+
+    status, _h, data = _req(openai_port, "GET", "/v1/models/nope")
+    assert status == 404
+    assert json.loads(data)["error"]["code"] == "model_not_found"
+
+
+def test_completions_unary(openai_port):
+    status, headers, data = _req(
+        openai_port, "POST", "/v1/completions",
+        body=json.dumps({"model": "tiny-lm", "prompt": "hello",
+                         "max_tokens": 8}),
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    assert headers["Content-Type"] == "application/json"
+    out = json.loads(data)
+    assert out["object"] == "text_completion"
+    assert out["model"] == "tiny-lm"
+    choice = out["choices"][0]
+    assert choice["finish_reason"] in ("stop", "length")
+    assert isinstance(choice["text"], str)
+    assert out["usage"]["prompt_tokens"] == 5   # byte tokenizer: len("hello")
+    assert out["usage"]["completion_tokens"] <= 8
+    assert out["usage"]["total_tokens"] == (
+        out["usage"]["prompt_tokens"] + out["usage"]["completion_tokens"])
+
+
+def test_completions_greedy_deterministic(openai_port):
+    body = json.dumps({"model": "tiny-lm", "prompt": "abc",
+                       "max_tokens": 6, "temperature": 0.0})
+    outs = set()
+    for _ in range(2):
+        _s, _h, data = _req(openai_port, "POST", "/v1/completions", body=body,
+                            headers={"Content-Type": "application/json"})
+        outs.add(json.loads(data)["choices"][0]["text"])
+    assert len(outs) == 1   # greedy: identical both times
+
+
+def test_chat_completions_unary(openai_port):
+    status, _h, data = _req(
+        openai_port, "POST", "/v1/chat/completions",
+        body=json.dumps({"model": "tiny-lm", "max_tokens": 8, "messages": [
+            {"role": "system", "content": "be brief"},
+            {"role": "user", "content": "hi"}]}),
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    out = json.loads(data)
+    assert out["object"] == "chat.completion"
+    msg = out["choices"][0]["message"]
+    assert msg["role"] == "assistant"
+    assert isinstance(msg["content"], str)
+    assert out["usage"]["prompt_tokens"] > 0
+
+
+def test_completions_stream_sse(openai_port):
+    status, headers, data = _req(
+        openai_port, "POST", "/v1/completions",
+        body=json.dumps({"model": "tiny-lm", "prompt": "xy",
+                         "max_tokens": 6, "stream": True}),
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    assert headers["Content-Type"].startswith("text/event-stream")
+    events = _sse_events(data)
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "text_completion" for c in chunks)
+    # last data chunk carries the finish_reason, earlier ones carry text
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+    streamed = "".join(c["choices"][0]["text"] for c in chunks)
+    assert isinstance(streamed, str)
+
+
+def test_chat_stream_role_then_deltas(openai_port):
+    status, _h, data = _req(
+        openai_port, "POST", "/v1/chat/completions",
+        body=json.dumps({"model": "tiny-lm", "max_tokens": 6, "stream": True,
+                         "messages": [{"role": "user", "content": "go"}]}),
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    events = _sse_events(data)
+    assert events[-1] == "[DONE]"
+    chunks = [json.loads(e) for e in events[:-1]]
+    assert all(c["object"] == "chat.completion.chunk" for c in chunks)
+    assert chunks[0]["choices"][0]["delta"] == {"role": "assistant"}
+    assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+
+
+def test_stream_vs_unary_same_text(openai_port):
+    """Greedy streaming must produce exactly the unary text."""
+    req = {"model": "tiny-lm", "prompt": "zz", "max_tokens": 6,
+           "temperature": 0.0}
+    _s, _h, data = _req(openai_port, "POST", "/v1/completions",
+                        body=json.dumps(req),
+                        headers={"Content-Type": "application/json"})
+    unary_text = json.loads(data)["choices"][0]["text"]
+    _s, _h, data = _req(openai_port, "POST", "/v1/completions",
+                        body=json.dumps({**req, "stream": True}),
+                        headers={"Content-Type": "application/json"})
+    chunks = [json.loads(e) for e in _sse_events(data)[:-1]]
+    assert "".join(c["choices"][0]["text"] for c in chunks) == unary_text
+
+
+def test_tokenize_detokenize_roundtrip(openai_port):
+    text = "héllo ✓"
+    status, _h, data = _req(
+        openai_port, "POST", "/tokenize",
+        body=json.dumps({"model": "tiny-lm", "prompt": text}),
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    out = json.loads(data)
+    assert out["count"] == len(out["tokens"])
+    status, _h, data = _req(
+        openai_port, "POST", "/detokenize",
+        body=json.dumps({"model": "tiny-lm", "tokens": out["tokens"]}),
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    assert json.loads(data)["prompt"] == text
+
+
+def test_openai_error_shapes(openai_port):
+    # unknown model
+    status, _h, data = _req(
+        openai_port, "POST", "/v1/completions",
+        body=json.dumps({"model": "missing", "prompt": "x"}),
+        headers={"Content-Type": "application/json"})
+    assert status == 404
+    assert json.loads(data)["error"]["type"] == "invalid_request_error"
+    # bad JSON
+    status, _h, data = _req(openai_port, "POST", "/v1/completions",
+                            body="{nope", headers={})
+    assert status == 400
+    # n > 1 unsupported
+    status, _h, data = _req(
+        openai_port, "POST", "/v1/completions",
+        body=json.dumps({"model": "tiny-lm", "prompt": "x", "n": 3}),
+        headers={"Content-Type": "application/json"})
+    assert status == 400
+    assert "n > 1" in json.loads(data)["error"]["message"]
+
+
+def test_stop_strings_unary():
+    """Stop sequences cut the text and set finish_reason=stop (no HTTP:
+    exercises the ingress directly for a crisp fixture)."""
+    import asyncio
+
+    from ray_tpu.serve.llm import LLMConfig
+    from ray_tpu.serve.openai_api import OpenAIIngress
+
+    ing = OpenAIIngress({"m": LLMConfig(
+        preset="tiny", max_batch_slots=2, max_seq_len=64,
+        model_overrides={"vocab_size": 260})})
+
+    async def run():
+        toks = await ing._generate(ing._engines["m"], ing._tok.encode("ab"),
+                                   max_tokens=8, eos_id=None)
+        full = ing._tok.decode(toks["tokens"])
+        if len(full) < 2:
+            pytest.skip("model generated too little text to split")
+        stop = full[1]
+        resp = await ing._completion_unary(
+            {"model": "m", "prompt": "ab", "max_tokens": 8, "stop": stop},
+            chat=False)
+        out = json.loads(resp.content)
+        choice = out["choices"][0]
+        assert stop not in choice["text"]
+        assert choice["text"] == full.split(stop)[0]
+        assert choice["finish_reason"] == "stop"
+
+    asyncio.run(run())
+
+
+def test_byte_tokenizer_incremental_decoder_multibyte():
+    from ray_tpu.serve.openai_api import ByteTokenizer, _IncrementalDecoder
+
+    tok = ByteTokenizer()
+    text = "a✓b€c"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    dec = _IncrementalDecoder(tok)
+    # feeding byte-by-byte must never emit a replacement char
+    out = "".join(dec.push(t) for t in ids) + dec.flush()
+    assert out == text
+    assert "�" not in out
+
+
+def test_unary_over_stream_path_says_connection_close(openai_port):
+    status, headers, _d = _req(
+        openai_port, "POST", "/v1/completions",
+        body=json.dumps({"model": "tiny-lm", "prompt": "q",
+                         "max_tokens": 2}),
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    # the proxy closes after a unary answer from a generator ingress; the
+    # header must say so or pooling clients reuse a dead socket
+    assert headers.get("Connection") == "close"
+
+
+def test_byte_tokenizer_ignores_out_of_range_ids():
+    from ray_tpu.serve.openai_api import ByteTokenizer
+
+    tok = ByteTokenizer()
+    # id 300 (vocab larger than 260) must not raise, just contribute nothing
+    assert tok.decode([tok.encode("a")[0], 300, tok.encode("b")[0]]) == "ab"
+
+
+def test_stream_stop_releases_slot_early():
+    """A stop-string hit mid-stream must free the engine slot promptly, not
+    keep decoding to max_tokens (slot + KV pages held for a finished
+    request)."""
+    import asyncio
+
+    from ray_tpu.serve.llm import LLMConfig
+    from ray_tpu.serve.openai_api import OpenAIIngress
+
+    ing = OpenAIIngress({"m": LLMConfig(
+        preset="tiny", max_batch_slots=2, max_seq_len=128,
+        model_overrides={"vocab_size": 260})})
+    eng = ing._engines["m"]
+
+    async def run():
+        toks = await eng.generate(ing._tok.encode("ab"), max_tokens=4)
+        full = ing._tok.decode(toks["tokens"])
+        if not full:
+            pytest.skip("model generated nothing to stop on")
+        stop = full[0]   # stops on the very first generated char
+        chunks = []
+        async for item in ing._completion_stream(
+                {"model": "m", "prompt": "ab", "max_tokens": 100,
+                 "stream": True, "stop": stop}, chat=False):
+            chunks.append(item)
+        assert chunks[-1]["choices"][0]["finish_reason"] == "stop"
+        # the slot must come free LONG before 100 tokens of decode
+        for _ in range(100):
+            if len(eng._free) == eng.config.max_batch_slots:
+                return
+            await asyncio.sleep(0.05)
+        raise AssertionError(
+            f"slot not released after stop: free={len(eng._free)} "
+            f"active={list(eng._active)}")
+
+    asyncio.run(run())
