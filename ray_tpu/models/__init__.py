@@ -12,6 +12,7 @@ from ray_tpu.models.llama import (
     llama_compute_flops,
     llama_param_count,
 )
+from ray_tpu.models.moe import MoEMLP, moe_aux_loss
 from ray_tpu.models.torsos import CNNTorso, MLPTorso
 
 __all__ = [
@@ -20,6 +21,8 @@ __all__ = [
     "LlamaConfig",
     "llama_compute_flops",
     "llama_param_count",
+    "MoEMLP",
+    "moe_aux_loss",
     "CNNTorso",
     "MLPTorso",
 ]

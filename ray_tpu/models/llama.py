@@ -25,6 +25,7 @@ import flax.struct
 import jax
 import jax.numpy as jnp
 
+from ray_tpu.models.moe import MoEMLP
 from ray_tpu.ops.attention import apply_rope, decode_attention, mha_reference
 from ray_tpu.ops.flash_attention import flash_attention
 from ray_tpu.ops.paged_attention import (PagedKVCache, paged_attention,
@@ -51,6 +52,15 @@ class LlamaConfig:
     attn_impl: str = "auto"         # auto | flash | xla | ring
     sp_axis: str = "sp"             # mesh axis for ring attention
     remat: bool = False
+    # ---- mixture-of-experts (Mixtral-family; models/moe.py). 0 = dense.
+    # When n_experts > 0 every `moe_every`-th block's FFN becomes a
+    # top-k-routed expert bank; weights carry a leading [E, ...] dim that
+    # `parallel.sharding.llama_rules()` shards over the `ep` mesh axis.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_every: int = 1              # 1 = every block (Mixtral layout)
+    capacity_factor: float = 1.25   # per-expert token budget multiplier
+    router_aux_weight: float = 0.01  # load-balance loss weight (sowed)
 
     # ---- presets (sizes follow the Llama family; test config is `tiny`).
     # kwargs override the preset's own values (e.g. tiny(max_seq_len=64)).
@@ -60,6 +70,22 @@ class LlamaConfig:
             vocab_size=256, d_model=64, n_layers=2, n_heads=4,
             n_kv_heads=2, head_dim=16, ffn_dim=128,
             max_seq_len=128, rope_theta=10000.0), **kw})
+
+    @staticmethod
+    def moe_tiny(**kw):
+        """Test-scale Mixtral layout: every FFN is a 4-expert top-2 bank."""
+        return LlamaConfig(**{**dict(
+            vocab_size=256, d_model=64, n_layers=2, n_heads=4,
+            n_kv_heads=2, head_dim=16, ffn_dim=128, max_seq_len=128,
+            rope_theta=10000.0, n_experts=4, moe_top_k=2), **kw})
+
+    @staticmethod
+    def mixtral_8x7b(**kw):
+        """Mixtral-8x7B shape: Llama-7B trunk, 8 experts, top-2 routing."""
+        return LlamaConfig(**{**dict(
+            vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+            n_kv_heads=8, head_dim=128, ffn_dim=14336, max_seq_len=32768,
+            rope_theta=1000000.0, n_experts=8, moe_top_k=2), **kw})
 
     @staticmethod
     def llama_125m(**kw):
@@ -246,7 +272,11 @@ class Block(nn.Module):
             RMSNorm(cfg.norm_eps, cfg.dtype, name="attn_norm")(x),
             positions, cache, paged_chunk_local)
         x = x + h
-        x = x + MLP(cfg, name="mlp")(RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(x))
+        if cfg.n_experts > 0 and self.layer_idx % cfg.moe_every == 0:
+            ffn = MoEMLP(cfg, name="moe")
+        else:
+            ffn = MLP(cfg, name="mlp")
+        x = x + ffn(RMSNorm(cfg.norm_eps, cfg.dtype, name="mlp_norm")(x))
         return x, new_kv
 
 
@@ -325,19 +355,41 @@ class Llama(nn.Module):
         return logits, new_cache
 
 
+def _n_moe_layers(cfg: LlamaConfig) -> int:
+    if cfg.n_experts <= 0:
+        return 0
+    return len(range(0, cfg.n_layers, cfg.moe_every))
+
+
 def llama_param_count(cfg: LlamaConfig) -> int:
     attn = cfg.d_model * cfg.head_dim * (cfg.n_heads * 2 + cfg.n_kv_heads * 2)
-    mlp = 3 * cfg.d_model * cfg.ffn_dim
+    dense_mlp = 3 * cfg.d_model * cfg.ffn_dim
     norms = 2 * cfg.d_model
-    per_layer = attn + mlp + norms
+    per_layer = attn + dense_mlp + norms
+    total = cfg.n_layers * per_layer
+    # MoE blocks swap the dense FFN for E experts + a router
+    n_moe = _n_moe_layers(cfg)
+    total += n_moe * ((cfg.n_experts - 1) * dense_mlp
+                      + cfg.d_model * cfg.n_experts)
     embed = cfg.vocab_size * cfg.d_model
     head = 0 if cfg.tie_embeddings else cfg.vocab_size * cfg.d_model
-    return cfg.n_layers * per_layer + embed + head + cfg.d_model
+    return total + embed + head + cfg.d_model
 
 
 def llama_compute_flops(cfg: LlamaConfig, batch: int, seq: int) -> float:
-    """Training FLOPs per step ≈ 6·N·tokens + attention term (causal)."""
-    n = llama_param_count(cfg) - cfg.vocab_size * cfg.d_model  # exclude embed lookup
+    """Training FLOPs per step ≈ 6·N_active·tokens + attention term
+    (causal). For MoE, N_active counts top_k experts per token, not the
+    full bank — the honest denominator for MFU."""
+    attn_p = cfg.d_model * cfg.head_dim * (cfg.n_heads * 2
+                                           + cfg.n_kv_heads * 2)
+    dense_mlp = 3 * cfg.d_model * cfg.ffn_dim
+    n_moe = _n_moe_layers(cfg)
+    n_dense = cfg.n_layers - n_moe
+    n_active = (cfg.n_layers * attn_p + n_dense * dense_mlp
+                + n_moe * (cfg.moe_top_k * dense_mlp
+                           + cfg.d_model * cfg.n_experts))
+    head = 0 if cfg.tie_embeddings else cfg.vocab_size * cfg.d_model
+    n_active += head
     tokens = batch * seq
     attn = 6 * cfg.n_layers * cfg.n_heads * cfg.head_dim * batch * seq * seq  # fwd 2 matmuls + bwd, halved for causal
-    return 6.0 * n * tokens + attn
+    return 6.0 * n_active * tokens + attn

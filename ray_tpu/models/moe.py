@@ -1,0 +1,111 @@
+"""Mixture-of-experts FFN for the Llama family (Mixtral layout).
+
+Reference parity: the reference serves MoE checkpoints (Mixtral et al.)
+through its vLLM/SGLang engines, whose CUDA kernels do scatter/gather
+token routing (e.g. python/ray/llm/_internal/serve/engines/sglang/
+sglang_engine.py engine wrapper). TPU-first re-design: routing is the
+GShard/Switch dense-dispatch formulation — one-hot dispatch/combine
+tensors contracted with einsums — because XLA turns those into large
+static-shape matmuls on the MXU, while data-dependent gather/scatter
+would defeat tiling. Expert weights carry a leading [E, ...] dim that
+`parallel.sharding.llama_rules()` maps to the `ep` mesh axis: under pjit
+the dispatch einsum becomes the token all-to-all over ICI, inserted by
+the compiler (scaling-book recipe), not hand-written collectives.
+
+Capacity: each expert processes at most C = ceil(top_k * S / E *
+capacity_factor) tokens (S = B*T tokens in the step, a static shape).
+Tokens over budget are dropped — their combine weight is zero and the
+block's residual connection carries them through unchanged, the standard
+Switch behavior.
+
+Load balancing: the Switch aux loss E * Σ_e f_e · P_e (f_e = fraction of
+tokens whose top-1 choice is e, P_e = mean router prob) is sowed into the
+"losses" collection as "moe_aux"; training code collects it with
+`model.apply(..., mutable=["losses"])` and adds
+`cfg.router_aux_weight * mean(aux)` to the task loss.
+"""
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed SwiGLU expert bank; drop-in for llama.MLP ([B,T,D] →
+    [B,T,D])."""
+
+    cfg: "LlamaConfig"  # noqa: F821 - llama.py owns the config class
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.cfg
+        E, K = cfg.n_experts, cfg.moe_top_k
+        B, T, D = x.shape
+        S = B * T
+        F = cfg.ffn_dim
+        xf = x.reshape(S, D)
+
+        # Router runs in f32: tiny compute, and bf16 softmax noise here
+        # flips expert assignments (standard practice, e.g. Mixtral).
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          param_dtype=jnp.float32,
+                          kernel_init=nn.initializers.normal(0.02),
+                          name="router")(xf.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)            # [S, E]
+        gate_vals, gate_idx = jax.lax.top_k(probs, K)       # [S, K]
+        gate_vals = gate_vals / jnp.maximum(
+            gate_vals.sum(-1, keepdims=True), 1e-9)         # renormalize
+
+        # Switch load-balance aux loss (top-1 assignment fractions)
+        f_e = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], E,
+                                      dtype=jnp.float32), axis=0)
+        p_e = jnp.mean(probs, axis=0)
+        self.sow("losses", "moe_aux", E * jnp.sum(f_e * p_e))
+
+        # Position of each (token, k) assignment inside its expert's queue,
+        # k-major (all first choices claim capacity before any second
+        # choice — GShard priority). Static shapes throughout.
+        C = max(1, math.ceil(cfg.capacity_factor * K * S / E))
+        sel = jax.nn.one_hot(gate_idx, E, dtype=jnp.int32)  # [S, K, E]
+        selk = sel.transpose(1, 0, 2).reshape(K * S, E)
+        pos = jnp.cumsum(selk, axis=0) - selk               # [K*S, E]
+        posk = (pos.reshape(K, S, E) *
+                sel.transpose(1, 0, 2)).sum(-1)             # [K, S]
+        keep = (posk < C).astype(jnp.float32)               # over-budget → 0
+        gates = gate_vals.T * keep                          # [K, S]
+
+        # combine[s, e, c]: gate weight of token s at slot c of expert e
+        combine = jnp.einsum(
+            "ks,kse,ksc->sec", gates,
+            sel.transpose(1, 0, 2).astype(jnp.float32),
+            jax.nn.one_hot(posk, C, dtype=jnp.float32))
+        dispatch = (combine > 0).astype(cfg.dtype)          # [S, E, C]
+
+        # Expert bank as single [E, ...] tensors: batched einsums keep the
+        # MXU busy and give the sharding engine one leading dim to slice
+        # over `ep`.
+        init = nn.initializers.normal(0.02)
+        w_gate = self.param("w_gate", init, (E, D, F), cfg.param_dtype)
+        w_up = self.param("w_up", init, (E, D, F), cfg.param_dtype)
+        w_down = self.param("w_down", init, (E, F, D), cfg.param_dtype)
+
+        expert_in = jnp.einsum("sec,sd->ecd", dispatch,
+                               xf.astype(cfg.dtype))        # [E, C, D]
+        h = jnp.einsum("ecd,edf->ecf", expert_in,
+                       w_gate.astype(cfg.dtype))
+        u = jnp.einsum("ecd,edf->ecf", expert_in,
+                       w_up.astype(cfg.dtype))
+        out = jnp.einsum("ecf,efd->ecd", nn.silu(h) * u,
+                         w_down.astype(cfg.dtype))          # [E, C, D]
+        y = jnp.einsum("sec,ecd->sd", combine.astype(cfg.dtype), out)
+        return y.reshape(B, T, D)
+
+
+def moe_aux_loss(losses_collection, weight: float) -> jnp.ndarray:
+    """Mean sowed router aux loss × weight; 0.0 when the model is dense."""
+    vals = jax.tree_util.tree_leaves(losses_collection)
+    if not vals:
+        return jnp.float32(0.0)
+    return weight * sum(jnp.mean(v) for v in vals) / len(vals)

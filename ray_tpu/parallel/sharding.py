@@ -100,6 +100,12 @@ def llama_rules() -> ShardingRules:
         (r"(w_gate|w_up)/kernel", P(("fsdp",), ("tp",))),      # [d, ffn]
         (r"w_down/kernel", P(("tp",), ("fsdp",))),             # [ffn, d]
         (r"lm_head/kernel", P(("fsdp",), ("tp",))),            # [d, vocab]
+        # MoE expert banks (models/moe.py): leading E dim over `ep`, inner
+        # dims shard like the dense FFN; the tiny router stays replicated
+        # so every shard routes identically
+        (r"moe/router/kernel", P()),                           # [d, E]
+        (r"moe/w_(gate|up)$", P(("ep",), ("fsdp",), ("tp",))),  # [E, d, ffn]
+        (r"moe/w_down$", P(("ep",), ("tp",), ("fsdp",))),       # [E, ffn, d]
         (r"(norm|ln)", P()),                                   # replicated
     ], default=P())
 

@@ -111,6 +111,18 @@ class LLMServer:
         if cfg.model_overrides:
             overrides.update(cfg.model_overrides)
         self.model_cfg = preset(**overrides)
+        if self.model_cfg.n_experts > 0:
+            # Serving must be DROPLESS: with the training default
+            # capacity_factor, a token's expert output could be zeroed
+            # because of which OTHER requests share the decode batch —
+            # same prompt, different completions under load. cf = E/K makes
+            # C = ceil(cf·K·S/E) = S, so every token always gets all its
+            # top-k experts regardless of co-batched traffic.
+            import dataclasses as _dc
+            dropless = self.model_cfg.n_experts / self.model_cfg.moe_top_k
+            if self.model_cfg.capacity_factor < dropless:
+                self.model_cfg = _dc.replace(self.model_cfg,
+                                             capacity_factor=dropless)
         self.model = Llama(self.model_cfg)
         B = cfg.max_batch_slots
         key = jax.random.PRNGKey(cfg.seed)
