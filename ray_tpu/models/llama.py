@@ -361,15 +361,25 @@ def _n_moe_layers(cfg: LlamaConfig) -> int:
     return len(range(0, cfg.n_layers, cfg.moe_every))
 
 
+def _attn_params(cfg: LlamaConfig) -> int:
+    """Per-layer attention weights — single source for count AND flops so
+    a layout change (biases, MLA, ...) can't desynchronize reported MFU
+    from the real parameter count."""
+    return cfg.d_model * cfg.head_dim * (cfg.n_heads * 2
+                                         + cfg.n_kv_heads * 2)
+
+
+def _mlp_params(cfg: LlamaConfig) -> int:
+    """One dense SwiGLU FFN (also the per-expert size in an MoE bank)."""
+    return 3 * cfg.d_model * cfg.ffn_dim
+
+
 def llama_param_count(cfg: LlamaConfig) -> int:
-    attn = cfg.d_model * cfg.head_dim * (cfg.n_heads * 2 + cfg.n_kv_heads * 2)
-    dense_mlp = 3 * cfg.d_model * cfg.ffn_dim
-    norms = 2 * cfg.d_model
-    per_layer = attn + dense_mlp + norms
+    per_layer = _attn_params(cfg) + _mlp_params(cfg) + 2 * cfg.d_model
     total = cfg.n_layers * per_layer
     # MoE blocks swap the dense FFN for E experts + a router
     n_moe = _n_moe_layers(cfg)
-    total += n_moe * ((cfg.n_experts - 1) * dense_mlp
+    total += n_moe * ((cfg.n_experts - 1) * _mlp_params(cfg)
                       + cfg.d_model * cfg.n_experts)
     embed = cfg.vocab_size * cfg.d_model
     head = 0 if cfg.tie_embeddings else cfg.vocab_size * cfg.d_model
@@ -380,13 +390,11 @@ def llama_compute_flops(cfg: LlamaConfig, batch: int, seq: int) -> float:
     """Training FLOPs per step ≈ 6·N_active·tokens + attention term
     (causal). For MoE, N_active counts top_k experts per token, not the
     full bank — the honest denominator for MFU."""
-    attn_p = cfg.d_model * cfg.head_dim * (cfg.n_heads * 2
-                                           + cfg.n_kv_heads * 2)
-    dense_mlp = 3 * cfg.d_model * cfg.ffn_dim
     n_moe = _n_moe_layers(cfg)
     n_dense = cfg.n_layers - n_moe
-    n_active = (cfg.n_layers * attn_p + n_dense * dense_mlp
-                + n_moe * (cfg.moe_top_k * dense_mlp
+    n_active = (cfg.n_layers * _attn_params(cfg)
+                + n_dense * _mlp_params(cfg)
+                + n_moe * (cfg.moe_top_k * _mlp_params(cfg)
                            + cfg.d_model * cfg.n_experts))
     head = 0 if cfg.tie_embeddings else cfg.vocab_size * cfg.d_model
     n_active += head

@@ -609,6 +609,38 @@ class LLMServer:
             # holding batch slot + KV pages — all the way to max_tokens
             slot.max_tokens = min(slot.max_tokens, len(slot.generated))
 
+    async def embed(self, prompt_ids: List[int]) -> List[float]:
+        """Mean-pooled final-hidden-state embedding of the prompt
+        (reference: /v1/embeddings on the LLM ingress). Pads to the same
+        power-of-two buckets as prefill — one compile per bucket; causal
+        attention means pad rows past the prompt cannot leak into the
+        pooled rows."""
+        import jax
+        import jax.numpy as jnp
+
+        P = len(prompt_ids)
+        if P == 0:
+            raise ValueError("cannot embed an empty prompt")
+        if P > self.config.max_seq_len:
+            raise ValueError(
+                f"prompt has {P} tokens but max_seq_len is "
+                f"{self.config.max_seq_len}")
+        b = self._bucket(P)
+        if not hasattr(self, "_embed_jit"):
+            def embed_fn(params, tokens, length):
+                hidden, _ = self.model.apply(params, tokens,
+                                             return_hidden=True)
+                mask = (jnp.arange(tokens.shape[1]) <
+                        length)[None, :, None].astype(hidden.dtype)
+                pooled = (hidden * mask).sum(axis=1) / jnp.maximum(
+                    length, 1).astype(hidden.dtype)
+                return pooled[0].astype(jnp.float32)
+            self._embed_jit = jax.jit(embed_fn)
+        tokens = np.zeros((1, b), np.int32)
+        tokens[0, :P] = prompt_ids
+        vec = self._embed_jit(self.params, jnp.asarray(tokens), jnp.int32(P))
+        return [float(x) for x in np.asarray(vec)]
+
     def stats(self) -> Dict[str, Any]:
         s = {"active": len(self._active), "free_slots": len(self._free),
              "requests": self._req_counter}

@@ -317,6 +317,47 @@ class OpenAIIngress:
         finish = "stop" if (stopped or n_toks < max_tokens) else "length"
         yield chunk(None, finish)
 
+    async def _embeddings(self, body) -> Response:
+        """OpenAI embeddings shape (reference ingress "embeddings" route):
+        input may be a string, a list of strings, or one token-id list."""
+        eng = self._engine(body.get("model"))
+        raw = body.get("input")
+        if isinstance(raw, str):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw and all(
+                isinstance(t, int) for t in raw):
+            inputs = [raw]
+        elif isinstance(raw, list) and raw and all(
+                isinstance(t, str) for t in raw):
+            inputs = raw
+        else:
+            raise OpenAIError(400, "'input' must be a string, a list of "
+                              "strings, or a token-id list")
+        import asyncio
+
+        id_lists = [item if isinstance(item, list)
+                    else self._tok.encode(item) for item in inputs]
+        for i, ids in enumerate(id_lists):
+            if not ids:
+                raise OpenAIError(400, f"'input' item {i} is empty")
+        total = sum(len(ids) for ids in id_lists)
+        if isinstance(eng, LLMServer):
+            vecs = [await eng.embed(ids) for ids in id_lists]
+        else:
+            # remote handles: dispatch every call, then gather — batch
+            # latency is bounded by engine throughput, not len(inputs)
+            # serial round-trips
+            loop = asyncio.get_running_loop()
+            resps = [await loop.run_in_executor(
+                None, lambda ids=ids: eng.embed.remote(ids))
+                for ids in id_lists]
+            vecs = await asyncio.gather(*resps)
+        data = [{"object": "embedding", "index": i, "embedding": v}
+                for i, v in enumerate(vecs)]
+        return _json_response({
+            "object": "list", "model": body["model"], "data": data,
+            "usage": {"prompt_tokens": total, "total_tokens": total}})
+
     def _prompt_of(self, body, chat: bool) -> Tuple[str, List[int]]:
         if chat:
             messages = body.get("messages")
@@ -368,6 +409,9 @@ class OpenAIIngress:
                 if not isinstance(ids, list):
                     raise OpenAIError(400, "'tokens' must be a list of ids")
                 yield _json_response({"prompt": self._tok.decode(ids)})
+                return
+            if path == "/v1/embeddings":
+                yield await self._embeddings(body)
                 return
             if path in ("/v1/completions", "/v1/chat/completions"):
                 chat = path.endswith("chat/completions")

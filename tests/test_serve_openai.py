@@ -293,3 +293,40 @@ def test_stream_stop_releases_slot_early():
             f"active={list(eng._active)}")
 
     asyncio.run(run())
+
+
+def test_embeddings_endpoint(openai_port):
+    status, _h, data = _req(
+        openai_port, "POST", "/v1/embeddings",
+        body=json.dumps({"model": "tiny-lm", "input": ["hello", "world"]}),
+        headers={"Content-Type": "application/json"})
+    assert status == 200
+    out = json.loads(data)
+    assert out["object"] == "list"
+    assert len(out["data"]) == 2
+    v0, v1 = (d["embedding"] for d in out["data"])
+    assert len(v0) == len(v1) > 0
+    assert out["usage"]["prompt_tokens"] == 10  # byte tokenizer
+    # same text embeds identically, different text differs
+    status, _h, data = _req(
+        openai_port, "POST", "/v1/embeddings",
+        body=json.dumps({"model": "tiny-lm", "input": "hello"}),
+        headers={"Content-Type": "application/json"})
+    again = json.loads(data)["data"][0]["embedding"]
+    assert again == pytest.approx(v0)
+    assert v0 != pytest.approx(v1)
+    # bad input shape -> 400
+    status, _h, _d = _req(
+        openai_port, "POST", "/v1/embeddings",
+        body=json.dumps({"model": "tiny-lm", "input": 42}),
+        headers={"Content-Type": "application/json"})
+    assert status == 400
+
+
+def test_embeddings_empty_input_is_400(openai_port):
+    status, _h, data = _req(
+        openai_port, "POST", "/v1/embeddings",
+        body=json.dumps({"model": "tiny-lm", "input": ["ok", ""]}),
+        headers={"Content-Type": "application/json"})
+    assert status == 400
+    assert "empty" in json.loads(data)["error"]["message"]
