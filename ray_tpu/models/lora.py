@@ -1,0 +1,118 @@
+"""LoRA adapters for the Llama family, functional-style.
+
+Reference parity: the reference serves LoRA checkpoints through its LLM
+ingress (python/ray/llm/_internal/serve/core/ingress/ingress.py
+`get_lora_model_ids` / lora_serve_utils) and fine-tunes via torch PEFT
+wrappers that monkey-patch Linear modules. TPU-first re-design: no module
+surgery. A LoRA adapter here is a pytree of {"a": [in, r], "b": [r, out]}
+factors addressed by the SAME param paths as the base weights, and
+
+    effective = params + scale * (a @ b)
+
+is computed functionally inside the jitted step (`apply_lora`). XLA fuses
+the rank-r expansion into the surrounding matmuls; a training step
+differentiates w.r.t. the adapter tree only, so optimizer state is O(r)
+— the standard JAX formulation, and the base params can stay donated /
+sharded exactly as before (the delta inherits their sharding from the
+einsum).
+
+Serving: `merge_lora` folds an adapter into a copy of the base params for
+zero-overhead decode; the serve multiplex cache (serve/multiplex.py) is
+the LRU that holds one merged model per adapter id, mirroring the
+reference's LoRA-multiplexing deployment pattern.
+"""
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# default: every attention projection + FFN matrix (2-D kernels only)
+DEFAULT_TARGETS = (r"(wq|wk|wv|wo)/kernel$",
+                   r"(w_gate|w_up|w_down)/kernel$")
+
+
+def _path_str(path) -> str:
+    """Single source for key-path stringification — init_lora and
+    apply_lora MUST agree on paths or an adapter silently no-ops."""
+    return "/".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def _flatten_with_paths(tree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(_path_str(path), leaf) for path, leaf in flat]
+
+
+def lora_targets(params, patterns: Sequence[str] = DEFAULT_TARGETS
+                 ) -> List[str]:
+    """Param paths an adapter will cover (2-D kernels matching patterns)."""
+    pats = [re.compile(p) for p in patterns]
+    return [path for path, leaf in _flatten_with_paths(params)
+            if getattr(leaf, "ndim", 0) == 2
+            and any(p.search(path) for p in pats)]
+
+
+def init_lora(key, params, rank: int = 8, alpha: float = 16.0,
+              patterns: Sequence[str] = DEFAULT_TARGETS) -> Dict[str, Any]:
+    """Create an adapter tree: {"scale", "factors": {path: {"a", "b"}}}.
+
+    `a` is gaussian, `b` zeros — the adapter starts as an exact no-op
+    (effective == base), the standard LoRA init.
+    """
+    factors = {}
+    targets = lora_targets(params, patterns)
+    if not targets:
+        raise ValueError(f"no params match LoRA patterns {list(patterns)}")
+    keys = jax.random.split(key, len(targets))
+    by_path = dict(_flatten_with_paths(params))
+    for k, path in zip(keys, targets):
+        w = by_path[path]
+        d_in, d_out = w.shape
+        factors[path] = {
+            "a": (jax.random.normal(k, (d_in, rank), jnp.float32)
+                  / jnp.sqrt(d_in)),
+            "b": jnp.zeros((rank, d_out), jnp.float32),
+        }
+    return {"scale": jnp.float32(alpha / rank), "factors": factors}
+
+
+def apply_lora(params, lora) -> Any:
+    """effective = params + scale·(a@b) on adapted paths; jit-friendly
+    (pure function of both trees — differentiate w.r.t. `lora` to train
+    the adapter with the base frozen).
+
+    Raises if any adapter factor matches no param path: a silently
+    ignored factor would serve/train the bare base model under the
+    adapter's name (wrong tree root, different config, renamed module)."""
+    factors = lora["factors"]
+    scale = lora["scale"]
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    param_paths = {_path_str(path) for path, _ in flat}
+    orphans = set(factors) - param_paths
+    if orphans:
+        raise ValueError(
+            f"LoRA factors match no param path (adapter built against a "
+            f"different tree?): {sorted(orphans)[:4]}... "
+            f"example param paths: {sorted(param_paths)[:2]}")
+    leaves = []
+    for path, leaf in flat:
+        f = factors.get(_path_str(path))
+        if f is not None:
+            delta = (f["a"] @ f["b"]).astype(leaf.dtype)
+            leaf = leaf + scale.astype(leaf.dtype) * delta
+        leaves.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def merge_lora(params, lora) -> Any:
+    """Fold the adapter into a fully-materialized NEW param tree for
+    serving: every leaf is copied, so the merged tree stays valid even if
+    the base tree's buffers are later donated inside a train step."""
+    return jax.tree_util.tree_map(jnp.array, apply_lora(params, lora))
+
+
+def lora_param_count(lora) -> int:
+    return sum(int(x.size)
+               for x in jax.tree_util.tree_leaves(lora["factors"]))

@@ -137,6 +137,16 @@ class OpenAIIngress:
         for name, engine in models.items():
             if isinstance(engine, LLMConfig):
                 engine = LLMServer(engine)
+            elif isinstance(engine, tuple):
+                # (LLMConfig, params): a checkpoint — e.g. a merge_lora'd
+                # adapter (models/lora.py) — served under its own model id.
+                # NOTE: each tuple entry is a RESIDENT engine (full params
+                # + its own KV cache); fine for a handful of model ids.
+                # Many adapters over one base should instead use a
+                # @serve.multiplexed loader calling merge_lora, so the
+                # multiplex LRU bounds device memory.
+                cfg_e, params_e = engine
+                engine = LLMServer(cfg_e, params=params_e)
             self._engines[name] = engine
 
     # -- engine access --------------------------------------------------------

@@ -1,0 +1,185 @@
+"""LoRA adapters (models/lora.py): exact no-op at init, adapter-only
+training with the base frozen, merge-for-serving. Reference contrast:
+the reference's PEFT path monkey-patches torch Linears; ours is a pure
+function of (params, adapter) differentiated w.r.t. the adapter."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+import pytest
+
+from ray_tpu.models import (Llama, LlamaConfig, apply_lora, init_lora,
+                            lora_param_count, lora_targets, merge_lora)
+
+
+@pytest.fixture(scope="module")
+def base():
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                           attn_impl="xla")
+    model = Llama(cfg)
+    tokens = jnp.asarray(
+        np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 16)))
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    return cfg, model, params, tokens
+
+
+def test_targets_cover_attn_and_ffn(base):
+    _, _, params, _ = base
+    targets = lora_targets(params)
+    assert any("wq/kernel" in t for t in targets)
+    assert any("w_down/kernel" in t for t in targets)
+    # embeddings / norms / lm_head are NOT adapted by default
+    assert not any("embed" in t or "norm" in t or "lm_head" in t
+                   for t in targets)
+
+
+def test_init_is_exact_noop(base):
+    """b=0 at init → effective == base, bit-for-bit."""
+    cfg, model, params, tokens = base
+    lora = init_lora(jax.random.PRNGKey(1), params, rank=4)
+    eff = apply_lora(params, lora)
+    ref, _ = model.apply(params, tokens)
+    out, _ = model.apply(eff, tokens)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(out))
+
+
+def test_adapter_is_tiny(base):
+    _, _, params, _ = base
+    lora = init_lora(jax.random.PRNGKey(1), params, rank=4)
+    n_base = sum(x.size for x in jax.tree_util.tree_leaves(params))
+    assert lora_param_count(lora) < n_base / 5
+
+
+def test_train_adapter_base_frozen(base):
+    """Gradient flows through apply_lora into the factors only; the loss
+    decreases while the base params never change."""
+    cfg, model, params, tokens = base
+    lora = init_lora(jax.random.PRNGKey(1), params, rank=8, alpha=16.0)
+    opt = optax.adam(1e-2)
+    opt_state = opt.init(lora)
+
+    def loss_fn(lora, tokens):
+        logits, _ = model.apply(apply_lora(params, lora), tokens[:, :-1])
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        tgt = jax.nn.one_hot(tokens[:, 1:], cfg.vocab_size)
+        return -jnp.mean(jnp.sum(tgt * logp, -1))
+
+    @jax.jit
+    def step(lora, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(lora, tokens)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(lora, updates), opt_state, loss
+
+    base_snapshot = jax.tree_util.tree_map(np.asarray, params)
+    losses = []
+    for _ in range(12):
+        lora, opt_state, loss = step(lora, opt_state, tokens)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] - 0.05, losses
+    # the base tree was never touched
+    for (p1, l1), (p2, l2) in zip(
+            jax.tree_util.tree_flatten_with_path(base_snapshot)[0],
+            jax.tree_util.tree_flatten_with_path(params)[0]):
+        np.testing.assert_array_equal(l1, np.asarray(l2))
+    # b is no longer zero — training actually moved the adapter
+    any_b = next(iter(lora["factors"].values()))["b"]
+    assert float(jnp.abs(any_b).sum()) > 0
+
+
+def test_merge_equals_functional(base):
+    cfg, model, params, tokens = base
+    lora = init_lora(jax.random.PRNGKey(2), params, rank=4)
+    # give the adapter real content
+    lora["factors"] = jax.tree_util.tree_map(
+        lambda x: x + 0.01, lora["factors"])
+    merged = merge_lora(params, lora)
+    out_f, _ = model.apply(apply_lora(params, lora), tokens)
+    out_m, _ = model.apply(merged, tokens)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_m),
+                               atol=1e-6)
+    # merged differs from base (the adapter does something)
+    out_b, _ = model.apply(params, tokens)
+    assert not np.allclose(np.asarray(out_b), np.asarray(out_m))
+
+
+def test_merged_adapter_serves_as_model():
+    """A fine-tuned adapter becomes a servable OpenAI model id: merge into
+    the base and hand the merged tree to the engine (the multiplex LRU is
+    the per-adapter cache in production)."""
+    import asyncio
+
+    from ray_tpu.serve.llm import LLMConfig as ServeConfig, LLMServer
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                           attn_impl="xla", max_seq_len=64)
+    model = Llama(cfg)
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), tokens)
+    lora = init_lora(jax.random.PRNGKey(1), params, rank=4)
+    merged = merge_lora(params, lora)
+
+    srv = LLMServer(ServeConfig(preset="tiny", max_batch_slots=2,
+                                max_seq_len=64,
+                                model_overrides={
+                                    "dtype": jnp.float32,
+                                    "param_dtype": jnp.float32,
+                                    "attn_impl": "xla"}),
+                    params=merged)
+    out = asyncio.run(srv.generate([1, 2, 3], max_tokens=4))
+    assert len(out["tokens"]) == 4
+
+
+def test_lora_model_id_in_openai_app(ray_session):
+    """(config, merged_params) registers an adapter as its own OpenAI
+    model id next to the base."""
+    import http.client
+    import json
+
+    from ray_tpu import serve
+    from ray_tpu.serve.llm import LLMConfig as ServeConfig
+
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                           attn_impl="xla", max_seq_len=64)
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    merged = merge_lora(params, init_lora(jax.random.PRNGKey(1), params,
+                                          rank=4))
+    sc = ServeConfig(preset="tiny", max_batch_slots=2, max_seq_len=64,
+                     model_overrides={"dtype": jnp.float32,
+                                      "param_dtype": jnp.float32,
+                                      "attn_impl": "xla"})
+    app = serve.build_openai_app({"base": (sc, params),
+                                  "base:my-adapter": (sc, merged)})
+    serve.run(app, name="lora-oai", route_prefix="/")
+    port = serve.start(http_options={"port": 0})
+    try:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("GET", "/v1/models")
+        resp = conn.getresponse()
+        ids = [m["id"] for m in json.loads(resp.read())["data"]]
+        assert ids == ["base", "base:my-adapter"]
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        conn.request("POST", "/v1/completions", json.dumps(
+            {"model": "base:my-adapter", "prompt": "hi", "max_tokens": 3}),
+            {"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        out = json.loads(resp.read())
+        assert resp.status == 200 and out["model"] == "base:my-adapter"
+    finally:
+        serve.shutdown()
+
+
+def test_mismatched_adapter_raises():
+    """Factors addressed against a different tree must raise, never
+    silently serve the bare base under the adapter's name."""
+    cfg = LlamaConfig.tiny(dtype=jnp.float32, param_dtype=jnp.float32,
+                           attn_impl="xla")
+    model = Llama(cfg)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    lora = init_lora(jax.random.PRNGKey(1), params, rank=4)
+    # simulate an adapter trained against a differently-rooted tree
+    lora["factors"] = {"wrong/root/" + k: v
+                      for k, v in lora["factors"].items()}
+    with pytest.raises(ValueError, match="no param path"):
+        apply_lora(params, lora)
