@@ -108,6 +108,103 @@ class Dataset:
             cols[name] = right.column(c)
         return from_blocks([pa.table(cols)])
 
+    def random_sample(self, fraction: float,
+                      *, seed: Optional[int] = None) -> "Dataset":
+        """Bernoulli row sample (ref: dataset.py random_sample): each row
+        kept with probability `fraction`, streamed per block. Seeded runs
+        mix the executor's stable block index into the per-block RNG, so
+        identical blocks (same content, same size) still draw independent
+        masks (r5 review: a content fingerprint alone correlated them)."""
+        if not 0.0 <= fraction <= 1.0:
+            raise ValueError(f"fraction must be in [0, 1], got {fraction}")
+
+        def _rs(block, idx):
+            if block.num_rows == 0:
+                return block
+            rng = np.random.default_rng(
+                None if seed is None else (seed, idx))
+            keep = rng.random(block.num_rows) < fraction
+            return block.filter(pa.array(keep))
+
+        return Dataset(self._plan.with_op(
+            BlockOp("random_sample", _rs, indexed=True)))
+
+    # ------------------------------------------------- global aggregations
+    def _scalar_agg(self, kind: str, on: Optional[str], ddof: int = 1):
+        """Streaming scalar aggregate (ref: Dataset.sum/min/max/mean/std):
+        per-block partials combine as they arrive — the plan executes
+        exactly ONCE (on=None infers the column from the first streamed
+        block, no separate schema() pass), and std uses Chan's parallel
+        (count, mean, M2) combine, never the cancellation-prone
+        E[x²]−E[x]² form (r5 review: float64 timestamps around 1.7e9 with
+        spread ~1 would have returned std=0.0)."""
+        import pyarrow.types as pt
+
+        blocks = self._plan.iter_blocks()
+        col = on
+        n = 0
+        mean = 0.0
+        m2 = 0.0
+        s = 0.0
+        mn = mx = None
+        for blk in blocks:
+            if blk.num_rows == 0:
+                continue
+            if col is None:
+                numeric = [f.name for f in blk.schema
+                           if pt.is_integer(f.type) or pt.is_floating(f.type)]
+                if len(numeric) != 1:
+                    raise ValueError(
+                        f"pass on=<column>: dataset has {len(numeric)} "
+                        f"numeric columns {numeric}")
+                col = numeric[0]
+            a = blk.column(col).to_numpy(zero_copy_only=False) \
+                .astype(np.float64)
+            nb = a.size
+            if kind in ("sum", "mean"):
+                s += float(a.sum())
+            elif kind == "std":
+                # Chan et al. pairwise combine of (n, mean, M2)
+                mb = float(a.mean())
+                m2b = float(((a - mb) ** 2).sum())
+                delta = mb - mean
+                tot = n + nb
+                m2 += m2b + delta * delta * n * nb / tot
+                mean += delta * nb / tot
+            elif kind == "min":
+                mn = float(a.min()) if mn is None else min(mn, float(a.min()))
+            elif kind == "max":
+                mx = float(a.max()) if mx is None else max(mx, float(a.max()))
+            n += nb
+        if n == 0:
+            return None
+        if kind == "sum":
+            return s
+        if kind == "mean":
+            return s / n
+        if kind == "min":
+            return mn
+        if kind == "max":
+            return mx
+        if n - ddof <= 0:
+            return 0.0
+        return float(np.sqrt(m2 / (n - ddof)))
+
+    def sum(self, on: Optional[str] = None):
+        return self._scalar_agg("sum", on)
+
+    def mean(self, on: Optional[str] = None):
+        return self._scalar_agg("mean", on)
+
+    def min(self, on: Optional[str] = None):
+        return self._scalar_agg("min", on)
+
+    def max(self, on: Optional[str] = None):
+        return self._scalar_agg("max", on)
+
+    def std(self, on: Optional[str] = None, ddof: int = 1):
+        return self._scalar_agg("std", on, ddof)
+
     # -------------------------------------------------------------- shuffles
     def random_shuffle(self, *, seed: Optional[int] = None,
                        num_partitions: int = 16) -> "Dataset":

@@ -21,9 +21,12 @@ from .streaming import (DEFAULT_OP_BUDGET, ShuffleOp, StreamingExecutor,
 
 @dataclass
 class BlockOp:
-    """Per-block transform (fusable)."""
+    """Per-block transform (fusable). `indexed=True` ops take
+    (block, block_idx) — the executor passes the stable per-stage block
+    index so seeded randomness can vary per block (e.g. random_sample)."""
     name: str
     fn: Callable[[pa.Table], pa.Table]
+    indexed: bool = False
 
 
 @dataclass
@@ -167,10 +170,11 @@ class Plan:
 
         def apply_fused(ops: List[BlockOp], blocks: Iterator[pa.Table]):
             fn = _fuse(ops)
+            indexed = getattr(fn, "indexed", False)
             names = "+".join(o.name for o in ops)
-            for blk in blocks:
+            for idx, blk in enumerate(blocks):
                 t0 = time.perf_counter()
-                out = fn(blk)
+                out = fn(blk, idx) if indexed else fn(blk)
                 stats.add(names, time.perf_counter() - t0, out.num_rows)
                 yield out
 
@@ -197,7 +201,17 @@ class Plan:
 
 
 def _fuse(ops: List[BlockOp]) -> Callable[[pa.Table], pa.Table]:
-    fns = [o.fn for o in ops]
+    pairs = [(o.fn, o.indexed) for o in ops]
+
+    if any(ix for _f, ix in pairs):
+        def fused(block: pa.Table, idx: int) -> pa.Table:
+            for f, ix in pairs:
+                block = f(block, idx) if ix else f(block)
+            return block
+        fused.indexed = True
+        return fused
+
+    fns = [f for f, _ix in pairs]
 
     def fused(block: pa.Table) -> pa.Table:
         for f in fns:

@@ -258,7 +258,14 @@ class StreamingExecutor:
                     idx, ref = st.pop_input()
                     if st.t0 is None:
                         st.t0 = time.perf_counter()
-                    out = self._remote(f"{i}:{st.name}", st.fn).remote(ref)
+                    if getattr(st.fn, "indexed", False):
+                        # indexed ops get the stable queue index so seeded
+                        # per-block randomness can't collide across blocks
+                        out = self._remote(f"{i}:{st.name}",
+                                           st.fn).remote(ref, idx)
+                    else:
+                        out = self._remote(f"{i}:{st.name}",
+                                           st.fn).remote(ref)
                     st.inflight[out] = idx
             else:
                 op = st.op

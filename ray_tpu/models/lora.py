@@ -87,7 +87,10 @@ def apply_lora(params, lora) -> Any:
     ignored factor would serve/train the bare base model under the
     adapter's name (wrong tree root, different config, renamed module)."""
     factors = lora["factors"]
-    scale = lora["scale"]
+    # scale is a HYPERPARAMETER (alpha/rank): stop_gradient keeps it fixed
+    # even though it lives in the adapter tree users differentiate — else
+    # the optimizer silently trains alpha away from its nominal value
+    scale = jax.lax.stop_gradient(lora["scale"])
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     param_paths = {_path_str(path) for path, _ in flat}
     orphans = set(factors) - param_paths

@@ -267,3 +267,63 @@ def test_data_tasks_execution(ray_session):
         lambda b: {"x": b["id"] * 2})
     vals = sorted(r["x"] for r in ds.take_all())
     assert vals == [i * 2 for i in range(40)]
+
+
+def test_random_sample(ray_session):
+    import ray_tpu.data as rdata
+    ds = rdata.range(2000).repartition(4)
+    n = ds.random_sample(0.25, seed=7).count()
+    assert 300 < n < 700  # ~500 expected
+    # deterministic under a fixed seed
+    assert (ds.random_sample(0.25, seed=7).count()
+            == ds.random_sample(0.25, seed=7).count())
+    assert ds.random_sample(0.0).count() == 0
+    assert ds.random_sample(1.0).count() == 2000
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        ds.random_sample(1.5)
+
+
+def test_global_scalar_aggregates(ray_session):
+    import numpy as np
+    import ray_tpu.data as rdata
+    vals = list(range(100))
+    ds = rdata.from_items([{"id": v, "x": float(v)} for v in vals]) \
+        .repartition(5)
+    assert ds.sum(on="x") == float(np.sum(vals))
+    assert ds.mean(on="x") == float(np.mean(vals))
+    assert ds.min(on="x") == 0.0
+    assert ds.max(on="x") == 99.0
+    assert abs(ds.std(on="x") - float(np.std(vals, ddof=1))) < 1e-9
+    # single numeric column -> on is optional
+    one = rdata.from_items([{"v": float(i)} for i in range(10)])
+    assert one.sum() == 45.0
+    # ambiguous columns -> must name one
+    import pytest as _pt
+    with _pt.raises(ValueError, match="numeric"):
+        ds.sum()
+
+
+def test_std_no_catastrophic_cancellation(ray_session):
+    """Large mean, tiny spread (timestamps ~1.7e9, std ~1): the naive
+    E[x^2]-E[x]^2 form returns 0.0 here; Chan's combine must not."""
+    import numpy as np
+    import ray_tpu.data as rdata
+    vals = 1.7e9 + np.arange(100, dtype=np.float64)
+    ds = rdata.from_items([{"t": float(v)} for v in vals]).repartition(4)
+    expected = float(np.std(vals, ddof=1))
+    assert abs(ds.std(on="t") - expected) / expected < 1e-6
+
+
+def test_random_sample_identical_blocks_decorrelated(ray_session):
+    """Blocks with identical content must draw independent masks under a
+    fixed seed (the executor's block index feeds the RNG)."""
+    import ray_tpu.data as rdata
+    ds = rdata.from_items([{"label": 0} for _ in range(4000)]) \
+        .repartition(8)
+    n = ds.random_sample(0.5, seed=11).count()
+    # 8 identical correlated blocks would give n = 8*k (multiples of 8
+    # with variance of a single 500-row draw); independent draws give a
+    # binomial(4000, .5) count — check it is not a multiple of 8 AND lies
+    # in the binomial 6-sigma band
+    assert 1810 < n < 2190, n
