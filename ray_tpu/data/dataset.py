@@ -62,6 +62,12 @@ class Dataset:
             return B.block_from_format(batch.assign(**{name: col}))
         return self._block_op("add_column", _ac)
 
+    def with_column(self, name: str, fn) -> "Dataset":
+        """Derive one column from the batch (ref: python/ray/data/dataset.py
+        with_column — expression-based there; a callable over the pandas
+        batch here, same contract as add_column)."""
+        return self.add_column(name, fn)
+
     def drop_columns(self, cols: List[str]) -> "Dataset":
         def _dc(block):
             keep = [c for c in block.column_names if c not in cols]
@@ -118,7 +124,7 @@ class Dataset:
         if not 0.0 <= fraction <= 1.0:
             raise ValueError(f"fraction must be in [0, 1], got {fraction}")
 
-        def _rs(block, idx):
+        def _rs(block, idx=None):
             if block.num_rows == 0:
                 return block
             rng = np.random.default_rng(
@@ -126,8 +132,11 @@ class Dataset:
             keep = rng.random(block.num_rows) < fraction
             return block.filter(pa.array(keep))
 
+        # Only the SEEDED sampler is position-dependent; an unseeded one
+        # never reads idx, and marking it indexed would needlessly push a
+        # later randomize_block_order off its metadata-only fast path.
         return Dataset(self._plan.with_op(
-            BlockOp("random_sample", _rs, indexed=True)))
+            BlockOp("random_sample", _rs, indexed=seed is not None)))
 
     # ------------------------------------------------- global aggregations
     def _scalar_agg(self, kind: str, on: Optional[str], ddof: int = 1):
@@ -145,7 +154,7 @@ class Dataset:
         n = 0
         mean = 0.0
         m2 = 0.0
-        s = 0.0
+        s = 0                 # stays exact int for integer columns
         mn = mx = None
         for blk in blocks:
             if blk.num_rows == 0:
@@ -158,11 +167,31 @@ class Dataset:
                         f"pass on=<column>: dataset has {len(numeric)} "
                         f"numeric columns {numeric}")
                 col = numeric[0]
-            a = blk.column(col).to_numpy(zero_copy_only=False) \
-                .astype(np.float64)
+            a = blk.column(col).to_numpy(zero_copy_only=False)
+            if np.issubdtype(a.dtype, np.integer):
+                # exact-int path for sum/min/max: int64 IDs/ns-timestamps
+                # above 2^53 would lose precision under a float64 cast.
+                # (A column WITH nulls never lands here — arrow converts
+                # it to float64+NaN above.)
+                if kind in ("mean", "std"):
+                    a = a.astype(np.float64)
+            else:
+                a = a.astype(np.float64)
+                # arrow nulls surface as NaN after the float cast: ignore
+                # them (reference aggregates default ignore_nulls=True)
+                # rather than letting one missing value poison the result
+                a = a[~np.isnan(a)]
             nb = a.size
+            if nb == 0:
+                continue
             if kind in ("sum", "mean"):
-                s += float(a.sum())
+                if np.issubdtype(a.dtype, np.integer):
+                    # object-dtype reduce = Python-int accumulation: exact
+                    # and overflow-free even WITHIN a block (a plain int64
+                    # a.sum() wraps silently at 2^63)
+                    s += int(a.sum(dtype=object))
+                else:
+                    s += float(a.sum())
             elif kind == "std":
                 # Chan et al. pairwise combine of (n, mean, M2)
                 mb = float(a.mean())
@@ -172,9 +201,11 @@ class Dataset:
                 m2 += m2b + delta * delta * n * nb / tot
                 mean += delta * nb / tot
             elif kind == "min":
-                mn = float(a.min()) if mn is None else min(mn, float(a.min()))
+                b = a.min().item()
+                mn = b if mn is None else min(mn, b)
             elif kind == "max":
-                mx = float(a.max()) if mx is None else max(mx, float(a.max()))
+                b = a.max().item()
+                mx = b if mx is None else max(mx, b)
             n += nb
         if n == 0:
             return None
@@ -187,8 +218,8 @@ class Dataset:
         if kind == "max":
             return mx
         if n - ddof <= 0:
-            return 0.0
-        return float(np.sqrt(m2 / (n - ddof)))
+            return float("nan")   # undefined (numpy convention), not a
+        return float(np.sqrt(m2 / (n - ddof)))  # fabricated zero spread
 
     def sum(self, on: Optional[str] = None):
         return self._scalar_agg("sum", on)
@@ -465,6 +496,68 @@ class Dataset:
             2, lambda total: [total - int(total * test_size)])
         return train, test
 
+    def split_proportionately(self, proportions: List[float]) -> List["Dataset"]:
+        """Split into len(proportions)+1 datasets; the last gets the
+        remainder (ref: python/ray/data/dataset.py split_proportionately)."""
+        if not proportions or any(p <= 0 for p in proportions):
+            raise ValueError("proportions must be positive")
+        if sum(proportions) >= 1.0:
+            raise ValueError("proportions must sum to < 1")
+
+        def edges(total):
+            out, acc = [], 0
+            for p in proportions:
+                acc += int(total * p)
+                out.append(min(acc, total))
+            return out
+
+        return self._split_streaming(len(proportions) + 1, edges)
+
+    def randomize_block_order(self, *, seed: Optional[int] = None) -> "Dataset":
+        """Shuffle block order without touching rows — the cheap
+        decorrelator for epoch reshuffling (ref: python/ray/data/dataset.py
+        randomize_block_order)."""
+        import random as _random
+
+        if all(isinstance(op, BlockOp) and not op.indexed
+               for op in self._plan.ops):
+            # Non-indexed per-block ops are order-preserving AND position-
+            # independent, so permuting the SOURCE thunk order permutes the
+            # output block order — metadata-only, nothing materializes (the
+            # epoch-reshuffle fast path). Indexed ops (seeded random_sample)
+            # derive per-block randomness from stream position, so for them
+            # we must reorder AFTER the op runs (barrier path below) or the
+            # permutation would change which rows are produced.
+            from .plan import DeferredSource
+            src, ops = self._plan.source, list(self._plan.ops)
+
+            def build():
+                thunks = list(src.thunks)
+                _random.Random(seed).shuffle(thunks)
+                return thunks
+
+            # recompute: an unseeded reorder must draw a FRESH permutation
+            # per execution (epoch), matching the barrier path; with a seed
+            # the rebuild is deterministic anyway
+            return Dataset(Plan(DeferredSource(build, "randomize_block_order",
+                                               recompute=True),
+                                ops, op_budget=self._plan.op_budget))
+
+        def _ro(blocks: List[pa.Table]) -> List[pa.Table]:
+            blocks = list(blocks)
+            _random.Random(seed).shuffle(blocks)
+            return blocks
+
+        # Position-dependent (indexed) or shuffle upstream: an exact
+        # whole-stream permutation needs every block before the first can
+        # be emitted, so this is a REAL barrier — it holds the block list
+        # in driver memory at this point in the chain (AllToAllOps like
+        # sort already do; streaming ShuffleOps like repartition do not).
+        # Prefer calling randomize_block_order BEFORE shuffles/samples to
+        # stay on the metadata-only fast path above.
+        return Dataset(self._plan.with_op(
+            AllToAllOp("randomize_block_order", _ro)))
+
     # ----------------------------------------------------------- consumption
     def to_block_list(self) -> List[pa.Table]:
         return self._plan.execute()
@@ -538,6 +631,49 @@ class Dataset:
             return prefetch_iterator(gen(), depth=prefetch_batches + 1)
         return gen()
 
+    def to_pandas(self, limit: Optional[int] = None):
+        """Whole dataset as one pandas DataFrame (ref:
+        python/ray/data/dataset.py to_pandas; `limit` guards accidental
+        concat-the-world on large data)."""
+        blocks, got = [], 0
+        for blk in self._plan.iter_blocks():
+            blocks.append(blk)
+            got += blk.num_rows
+            if limit is not None and got > limit:
+                raise ValueError(
+                    f"dataset has more than limit={limit} rows; raise the "
+                    f"limit or use iter_batches for streaming consumption")
+        if not blocks:
+            import pandas as pd
+            return pd.DataFrame()
+        return B.block_concat(blocks).to_pandas()
+
+    def iter_torch_batches(self, *, batch_size: int = 256,
+                           dtypes=None, device: str = "cpu",
+                           prefetch_batches: int = 1,
+                           drop_last: bool = False) -> Iterator:
+        """Batches as dicts of torch tensors (ref: python/ray/data/dataset.py
+        iter_torch_batches). CPU torch is the interop target here — the TPU
+        input path is iter_device_batches (jax)."""
+        import torch
+
+        for batch in self.iter_batches(batch_size=batch_size,
+                                       batch_format="numpy",
+                                       prefetch_batches=prefetch_batches,
+                                       drop_last=drop_last):
+            out = {}
+            for k, v in batch.items():
+                arr = np.ascontiguousarray(v)
+                if not arr.flags.writeable:  # arrow buffers are read-only
+                    arr = arr.copy()
+                t = torch.as_tensor(arr)
+                if dtypes is not None:
+                    want = dtypes.get(k) if isinstance(dtypes, dict) else dtypes
+                    if want is not None:
+                        t = t.to(want)
+                out[k] = t.to(device) if device != "cpu" else t
+            yield out
+
     def iter_device_batches(self, *, batch_size: int = 256, sharding=None,
                             prefetch: int = 2, drop_last: bool = True):
         """Batches as device arrays, double-buffered host→HBM (the TPU input
@@ -589,6 +725,85 @@ class Dataset:
                 with fsys.open_output_stream(f"{root}/{name}") as f:
                     f.write(buf.getvalue())
                 row_idx += 1
+
+    def write_tfrecords(self, path: str) -> None:
+        """One TFRecord file per block, streamed (ref:
+        python/ray/data/dataset.py:4724 write_tfrecords). Records carry
+        verified masked crc32c — TF's RecordReader accepts them."""
+        from .readers import write_record
+        fsys, root = _resolve_fs(path)
+        fsys.create_dir(root, recursive=True)
+        for i, blk in enumerate(self._plan.iter_blocks()):
+            with fsys.open_output_stream(f"{root}/part-{i:05d}.tfrecords") as f:
+                for row in B.block_to_rows(blk):
+                    write_record(f, row)
+
+    def write_numpy(self, path: str, *, column: str) -> None:
+        """One .npy file per block from a single column (ref:
+        python/ray/data/dataset.py write_numpy)."""
+        fsys, root = _resolve_fs(path)
+        fsys.create_dir(root, recursive=True)
+        for i, blk in enumerate(self._plan.iter_blocks()):
+            arr = np.asarray(B.block_to_format(blk, "numpy")[column])
+            import io
+            buf = io.BytesIO()
+            np.save(buf, arr)
+            with fsys.open_output_stream(f"{root}/part-{i:05d}.npy") as f:
+                f.write(buf.getvalue())
+
+    def write_webdataset(self, path: str) -> None:
+        """One tar shard per block in webdataset layout — members named
+        `<__key__>.<ext>` per non-key column, bytes passthrough, everything
+        else repr()'d to bytes (round-trip partner of read_webdataset; ref:
+        python/ray/data/dataset.py write_webdataset)."""
+        import io
+        import tarfile
+        fsys, root = _resolve_fs(path)
+        fsys.create_dir(root, recursive=True)
+        row_idx = 0
+        for i, blk in enumerate(self._plan.iter_blocks()):
+            buf = io.BytesIO()
+            seen: set = set()
+            with tarfile.open(fileobj=buf, mode="w") as tar:
+                for row in B.block_to_rows(blk):
+                    key = str(row.get("__key__", f"{row_idx:06d}"))
+                    if key in seen:
+                        # read-back groups members by stem, so a repeated
+                        # key within a shard silently merges two samples
+                        raise ValueError(
+                            f"duplicate webdataset __key__ within a "
+                            f"shard: {key!r}")
+                    seen.add(key)
+                    if any(c in key for c in "./\\"):
+                        # read_webdataset groups members by basename stem
+                        # before the first dot (the webdataset convention),
+                        # so dots or path separators in a key silently
+                        # split/merge samples on read-back
+                        raise ValueError(
+                            f"webdataset __key__ may not contain '.', '/' "
+                            f"or '\\': {key!r}")
+                    for col, val in row.items():
+                        if col == "__key__":
+                            continue
+                        if any(c in col for c in "/\\"):
+                            # a slashed column turns the tar member name
+                            # into a path: read-back basenames it and the
+                            # sample corrupts exactly like a slashed key
+                            raise ValueError(
+                                f"webdataset column names may not contain "
+                                f"'/' or '\\': {col!r}")
+                        if isinstance(val, (bytes, bytearray)):
+                            data = bytes(val)
+                        elif isinstance(val, str):
+                            data = val.encode()
+                        else:
+                            data = repr(val).encode()
+                        info = tarfile.TarInfo(name=f"{key}.{col}")
+                        info.size = len(data)
+                        tar.addfile(info, io.BytesIO(data))
+                    row_idx += 1
+            with fsys.open_output_stream(f"{root}/shard-{i:05d}.tar") as f:
+                f.write(buf.getvalue())
 
     def _write(self, path: str, fmt: str) -> None:
         fsys, root = _resolve_fs(path)

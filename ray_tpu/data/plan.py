@@ -49,13 +49,21 @@ class DeferredSource:
     sides' hash shuffles when the joined dataset is consumed) while keeping
     dataset construction lazy."""
 
-    def __init__(self, builder: Callable[[], List[Callable]], name: str):
+    def __init__(self, builder: Callable[[], List[Callable]], name: str,
+                 recompute: bool = False):
+        # recompute=True re-runs the builder on EVERY access — for sources
+        # whose thunk list must differ per execution (an unseeded
+        # randomize_block_order re-permutes each epoch; the memoized
+        # default would freeze the first permutation forever)
         self._builder = builder
         self._thunks: Optional[List[Callable]] = None
+        self._recompute = recompute
         self.name = name
 
     @property
     def thunks(self) -> List[Callable]:
+        if self._recompute:
+            return self._builder()
         if self._thunks is None:
             self._thunks = self._builder()
         return self._thunks

@@ -291,6 +291,19 @@ def _masked_crc_legacy(data: bytes) -> int:
     return ((crc >> 15 | crc << 17) + 0xA282EAD8) & 0xFFFFFFFF
 
 
+def write_record(f, row: Dict) -> None:
+    """Frame ONE row as a TFRecord onto stream `f` (length + masked crc32c
+    + tf.train.Example payload + payload crc). The single wire-format
+    implementation — both write_tfrecords here and Dataset.write_tfrecords
+    call this, so a framing fix lands everywhere at once."""
+    data = _encode_example(row)
+    hdr = struct.pack("<Q", len(data))
+    f.write(hdr)
+    f.write(struct.pack("<I", _masked_crc(hdr)))
+    f.write(data)
+    f.write(struct.pack("<I", _masked_crc(data)))
+
+
 def write_tfrecords(ds_or_rows, path: str) -> str:
     """Write rows as tf.train.Example TFRecords (round-trip partner of
     read_tfrecords)."""
@@ -298,11 +311,7 @@ def write_tfrecords(ds_or_rows, path: str) -> str:
             else list(ds_or_rows))
     with open(path, "wb") as f:
         for row in rows:
-            data = _encode_example(row)
-            f.write(struct.pack("<Q", len(data)))
-            f.write(struct.pack("<I", _masked_crc(struct.pack("<Q", len(data)))))
-            f.write(data)
-            f.write(struct.pack("<I", _masked_crc(data)))
+            write_record(f, row)
     return path
 
 

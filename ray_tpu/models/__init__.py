@@ -12,8 +12,8 @@ from ray_tpu.models.llama import (
     llama_compute_flops,
     llama_param_count,
 )
-from ray_tpu.models.lora import (apply_lora, init_lora, lora_param_count,
-                                 lora_targets, merge_lora)
+from ray_tpu.models.lora import (apply_lora, init_lora, lora_opt_mask,
+                                 lora_param_count, lora_targets, merge_lora)
 from ray_tpu.models.moe import MoEMLP, moe_aux_loss
 from ray_tpu.models.torsos import CNNTorso, MLPTorso
 
@@ -27,6 +27,7 @@ __all__ = [
     "moe_aux_loss",
     "apply_lora",
     "init_lora",
+    "lora_opt_mask",
     "lora_param_count",
     "lora_targets",
     "merge_lora",

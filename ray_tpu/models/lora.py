@@ -87,9 +87,11 @@ def apply_lora(params, lora) -> Any:
     ignored factor would serve/train the bare base model under the
     adapter's name (wrong tree root, different config, renamed module)."""
     factors = lora["factors"]
-    # scale is a HYPERPARAMETER (alpha/rank): stop_gradient keeps it fixed
-    # even though it lives in the adapter tree users differentiate — else
-    # the optimizer silently trains alpha away from its nominal value
+    # scale is a HYPERPARAMETER (alpha/rank): stop_gradient zeroes its
+    # gradient, but that alone doesn't protect it from optimizers with
+    # DECOUPLED weight decay (adamw shrinks every leaf by lr·wd·leaf
+    # regardless of gradient) — wrap such optimizers with
+    # optax.masked(opt, lora_opt_mask(lora)) so scale is never updated
     scale = jax.lax.stop_gradient(lora["scale"])
     flat, treedef = jax.tree_util.tree_flatten_with_path(params)
     param_paths = {_path_str(path) for path, _ in flat}
@@ -114,6 +116,19 @@ def merge_lora(params, lora) -> Any:
     serving: every leaf is copied, so the merged tree stays valid even if
     the base tree's buffers are later donated inside a train step."""
     return jax.tree_util.tree_map(jnp.array, apply_lora(params, lora))
+
+
+def lora_opt_mask(lora) -> Dict[str, Any]:
+    """Boolean pytree for optax.masked / optax.multi_transform: True on
+    trainable leaves (the factors), False on the scale hyperparameter.
+
+    Needed because stop_gradient only zeroes scale's GRADIENT — an
+    optimizer with decoupled weight decay (adamw) still applies
+    `-lr·wd·scale` every step and silently decays alpha/rank toward 0.
+    Usage: opt = optax.masked(optax.adamw(...), lora_opt_mask(lora))."""
+    return {"scale": False,
+            "factors": jax.tree_util.tree_map(lambda _: True,
+                                              lora["factors"])}
 
 
 def lora_param_count(lora) -> int:

@@ -327,3 +327,180 @@ def test_random_sample_identical_blocks_decorrelated(ray_session):
     # binomial(4000, .5) count — check it is not a multiple of 8 AND lies
     # in the binomial 6-sigma band
     assert 1810 < n < 2190, n
+
+
+def test_with_column_and_randomize_block_order():
+    ds = rd.from_items([{"a": i} for i in range(12)]).repartition(4)
+    ds2 = ds.with_column("b", lambda batch: batch["a"] * 3)
+    assert all(r["b"] == r["a"] * 3 for r in ds2.take_all())
+    # randomize_block_order: same rows, deterministic under seed
+    r1 = ds.randomize_block_order(seed=7).take_all()
+    r2 = ds.randomize_block_order(seed=7).take_all()
+    assert r1 == r2
+    assert sorted(r["a"] for r in r1) == list(range(12))
+
+
+def test_split_proportionately():
+    ds = rd.range(100)
+    a, b, c = ds.split_proportionately([0.1, 0.3])
+    assert (a.count(), b.count(), c.count()) == (10, 30, 60)
+    got = [r["id"] for part in (a, b, c) for r in part.take_all()]
+    assert got == list(range(100))
+    with pytest.raises(ValueError):
+        ds.split_proportionately([0.6, 0.5])
+
+
+def test_to_pandas_and_iter_torch_batches():
+    ds = rd.from_items([{"x": float(i), "y": i} for i in range(10)])
+    df = ds.to_pandas()
+    assert list(df["y"]) == list(range(10))
+    with pytest.raises(ValueError):
+        ds.to_pandas(limit=5)
+    import torch
+    batches = list(ds.iter_torch_batches(batch_size=4))
+    assert [len(b["x"]) for b in batches] == [4, 4, 2]
+    assert isinstance(batches[0]["x"], torch.Tensor)
+    typed = next(iter(ds.iter_torch_batches(
+        batch_size=4, dtypes={"x": torch.float64})))
+    assert typed["x"].dtype == torch.float64
+
+
+def test_write_tfrecords_numpy_webdataset_methods(tmp_path, ray_session):
+    ds = rd.from_items(
+        [{"a": i, "s": f"row{i}"} for i in range(9)]).repartition(3)
+    # tfrecords: streamed one file per block, read back by read_tfrecords
+    out = str(tmp_path / "tfr")
+    ds.write_tfrecords(out)
+    back = rd.read_tfrecords(out)
+    assert sorted(r["a"] for r in back.take_all()) == list(range(9))
+    # numpy: one .npy per block
+    nout = tmp_path / "npy"
+    ds.write_numpy(str(nout), column="a")
+    arrs = [np.load(p) for p in sorted(nout.glob("*.npy"))]
+    assert sorted(np.concatenate(arrs).tolist()) == list(range(9))
+    # webdataset: tar shards keyed by __key__, bytes round-trip
+    wds = rd.from_items(
+        [{"__key__": f"k{i}", "img": bytes([i] * 4)} for i in range(4)])
+    wout = str(tmp_path / "wds")
+    wds.write_webdataset(wout)
+    back = rd.read_webdataset(wout)
+    rows = {r["__key__"]: r["img"] for r in back.take_all()}
+    assert rows == {f"k{i}": bytes([i] * 4) for i in range(4)}
+
+
+def test_scalar_aggregates_ignore_nulls():
+    """One missing value must not poison sum/mean/std/min/max to NaN
+    (reference aggregates default ignore_nulls=True)."""
+    ds = rd.from_items([{"x": 1.0}, {"x": None}, {"x": 3.0}])
+    assert ds.sum(on="x") == 4.0
+    assert ds.mean(on="x") == 2.0
+    assert ds.min(on="x") == 1.0
+    assert ds.max(on="x") == 3.0
+    assert abs(ds.std(on="x") - np.std([1.0, 3.0], ddof=1)) < 1e-12
+
+
+def test_to_pandas_empty_and_webdataset_str_roundtrip(tmp_path):
+    # empty result -> empty DataFrame, not None
+    empty = rd.range(5).filter(lambda r: False)
+    df = empty.to_pandas()
+    assert df is not None and len(df) == 0
+    # str columns round-trip without repr() quotes
+    ds = rd.from_items([{"__key__": "k0", "txt": "hello"}])
+    out = str(tmp_path / "wds_str")
+    ds.write_webdataset(out)
+    row = rd.read_webdataset(out).take_all()[0]
+    assert row["txt"] == b"hello"
+
+
+def test_randomize_block_order_is_lazy_on_block_op_chains():
+    """The fast path permutes source thunks — no upstream execution at
+    call time (the AllToAllOp fallback is only for post-barrier chains)."""
+    pulled = []
+
+    def tag(r):
+        pulled.append(r["id"])
+        return r
+
+    # pure BlockOp chain over a 4-block source → thunk-permute fast path
+    ds = rd.range(20, override_num_blocks=4).map(tag)
+    pulled.clear()
+    ro = ds.randomize_block_order(seed=1)   # must not execute anything
+    assert pulled == []
+    from ray_tpu.data.plan import DeferredSource
+    assert isinstance(ro._plan.source, DeferredSource)
+    assert sorted(r["id"] for r in ro.take_all()) == list(range(20))
+    # block ORDER actually changed vs the unshuffled chain under this seed
+    ids = [r["id"] for r in ro.take_all()]
+    assert ids != list(range(20))
+
+
+def test_write_webdataset_rejects_dotted_keys(tmp_path):
+    ds = rd.from_items([{"__key__": "img.v2", "jpg": b"x"}])
+    with pytest.raises(ValueError, match="__key__"):
+        ds.write_webdataset(str(tmp_path / "w"))
+
+
+def test_randomize_block_order_preserves_indexed_op_output():
+    """Seeded random_sample derives randomness from stream position:
+    appending randomize_block_order must reorder its OUTPUT, never change
+    which rows were sampled (r5 review repro)."""
+    base = rd.range(1000, override_num_blocks=4).random_sample(0.5, seed=7)
+    want = sorted(r["id"] for r in base.take_all())
+    got = sorted(r["id"] for r in
+                 base.randomize_block_order(seed=1).take_all())
+    assert got == want
+
+
+def test_write_webdataset_rejects_slashed_keys(tmp_path):
+    ds = rd.from_items([{"__key__": "a/b", "x": b"1"}])
+    with pytest.raises(ValueError, match="__key__"):
+        ds.write_webdataset(str(tmp_path / "w"))
+
+
+def test_randomize_block_order_unseeded_reshuffles_per_epoch():
+    """seed=None must draw a FRESH permutation on every execution of the
+    same Dataset (epoch reshuffle), on the fast path too (r5 review: the
+    memoized DeferredSource froze the first permutation forever)."""
+    ds = rd.range(64, override_num_blocks=16).randomize_block_order()
+    orders = {tuple(r["id"] for r in ds.take_all()) for _ in range(6)}
+    assert len(orders) > 1
+    assert all(sorted(o) == list(range(64)) for o in orders)
+
+
+def test_write_webdataset_rejects_slashed_columns(tmp_path):
+    ds = rd.from_items([{"__key__": "k0", "a/b": b"x"}])
+    with pytest.raises(ValueError, match="column"):
+        ds.write_webdataset(str(tmp_path / "w"))
+
+
+def test_scalar_aggregates_exact_for_big_ints_and_nan_std():
+    big = 2 ** 62 + 1
+    ds = rd.from_items([{"x": big}, {"x": 1}])
+    assert ds.sum(on="x") == big + 1          # exact, no float64 rounding
+    assert ds.max(on="x") == big
+    assert ds.min(on="x") == 1
+    # std of a single row is undefined → nan, not 0.0
+    assert np.isnan(rd.from_items([{"x": 5.0}]).std(on="x"))
+
+
+def test_unseeded_random_sample_keeps_reorder_fast_path():
+    """indexed only when seeded: unseeded sample + reorder must stay on
+    the metadata-only DeferredSource path (r5 review)."""
+    from ray_tpu.data.plan import DeferredSource
+    ro = rd.range(40, override_num_blocks=4).random_sample(
+        0.5).randomize_block_order(seed=1)
+    assert isinstance(ro._plan.source, DeferredSource)
+    # seeded sample stays on the barrier path (position-dependent)
+    ro2 = rd.range(40, override_num_blocks=4).random_sample(
+        0.5, seed=3).randomize_block_order(seed=1)
+    assert not isinstance(ro2._plan.source, DeferredSource)
+
+
+def test_sum_no_int64_wrap_within_block_and_dup_webdataset_keys(tmp_path):
+    # both big rows in ONE block: int64 a.sum() would wrap to -2**63
+    ds = rd.from_items([{"x": 2 ** 62}, {"x": 2 ** 62}])
+    assert ds.sum(on="x") == 2 ** 63
+    dup = rd.from_items([{"__key__": "k", "a": b"1"},
+                         {"__key__": "k", "a": b"2"}]).repartition(1)
+    with pytest.raises(ValueError, match="duplicate"):
+        dup.write_webdataset(str(tmp_path / "w"))

@@ -183,3 +183,32 @@ def test_mismatched_adapter_raises():
                       for k, v in lora["factors"].items()}
     with pytest.raises(ValueError, match="no param path"):
         apply_lora(params, lora)
+
+
+def test_lora_opt_mask_protects_scale_from_adamw_decay(base):
+    """stop_gradient zeroes scale's grad, but adamw's DECOUPLED weight
+    decay still shrinks every optimizer-visible leaf; optax.masked with
+    lora_opt_mask must keep scale exactly fixed while factors update."""
+    import optax
+
+    from ray_tpu.models import lora_opt_mask
+
+    _, model, params, tokens = base
+    lora = init_lora(jax.random.PRNGKey(1), params, rank=4, alpha=16.0)
+    opt = optax.masked(optax.adamw(1e-2, weight_decay=0.1),
+                       lora_opt_mask(lora))
+    state = opt.init(lora)
+
+    def loss_fn(lo):
+        logits, _ = model.apply(apply_lora(params, lo), tokens)
+        return jnp.mean(logits.astype(jnp.float32) ** 2)
+
+    before = float(lora["scale"])
+    for _ in range(3):
+        g = jax.grad(loss_fn)(lora)
+        updates, state = opt.update(g, state, lora)
+        lora = optax.apply_updates(lora, updates)
+    assert float(lora["scale"]) == before
+    # factors actually moved (the mask didn't freeze everything)
+    any_a = next(iter(lora["factors"].values()))["a"]
+    assert float(jnp.abs(any_a).sum()) > 0.0
