@@ -525,7 +525,13 @@ def measure(config_name):
         batch, seq = 2, 256
     # perf-sweep overrides (r5: how the MFU tuning experiments are driven)
     batch = int(os.environ.get("RAY_TPU_BENCH_BATCH", batch))
-    remat = os.environ.get("RAY_TPU_BENCH_REMAT", "1") != "0"
+    # r5 sweep (benchmarks/mfu_sweep.py on a real v5e): llama_1b@b4/s2048
+    # WITHOUT remat hits MFU 0.531 / 20.4k tok/s vs 0.478 with — at this
+    # size activations fit HBM, so recomputing the forward is pure FLOP
+    # tax. Default noremat for the small-batch headline; remat stays the
+    # default for anything bigger (b8 noremat OOMs).
+    remat_default = "0" if (config_name == "llama_1b" and batch <= 4) else "1"
+    remat = os.environ.get("RAY_TPU_BENCH_REMAT", remat_default) != "0"
     if config_name == "llama_1b":
         # bf16 params + remat: ~0.9B params -> 1.7G params + 1.7G grads +
         # 3.4G adam (mu/nu mirror param dtype) fits a 16G v5e chip.

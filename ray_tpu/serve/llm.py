@@ -55,6 +55,18 @@ class LLMConfig:
     # content-addressed and shared across requests with refcounts — a
     # repeated prompt prefix skips its prefill entirely (TTFT win).
     prefix_cache: bool = True
+    # Prompt-lookup speculative decoding (dense cache only; ref: the
+    # reference serves draft-model speculation through its vLLM engine
+    # config — here the draft is FREE: the continuation of the most recent
+    # n-gram match in the request's own prompt+output, verified in ONE
+    # [B, K+1] forward. Decode is HBM-bound on TPU (the K+1-position
+    # forward re-reads the same cache a [B, 1] step would), so verification
+    # costs little; on repetitive text K tokens land per tick instead
+    # of 1. Greedy slots stay EXACT (an accepted draft token equals the
+    # argmax target by construction); sampled slots take one token per
+    # tick from the unchanged position-0 sampler.
+    speculate: int = 0              # K draft tokens per tick (0 = off)
+    spec_ngram: int = 3             # n-gram length for the prompt lookup
     # extra LlamaConfig kwargs applied over the preset (e.g. vocab_size for
     # a tokenizer whose id space outgrows the preset's)
     model_overrides: Optional[Dict[str, Any]] = None
@@ -76,6 +88,14 @@ class _Slot:
     top_k: int = 0
     want_logprobs: bool = False
     logprobs: List[float] = dataclasses.field(default_factory=list)
+    # full context (prompt + generated) for prompt-lookup drafting
+    prompt_ids: List[int] = dataclasses.field(default_factory=list)
+    # incremental prompt-lookup state (greedy slots, speculate>0 only):
+    # ctx mirrors prompt+generated; spec_index maps each n-gram WITH a
+    # known continuation to that continuation's start — O(1) draft lookup
+    # per tick instead of an O(context) scan on the event loop
+    ctx: List[int] = dataclasses.field(default_factory=list)
+    spec_index: Dict = dataclasses.field(default_factory=dict)
     # set when the first token exists (prefill complete); TTFT boundary
     first_token: asyncio.Event = dataclasses.field(
         default_factory=asyncio.Event)
@@ -130,6 +150,13 @@ class LLMServer:
             params = self.model.init(
                 key, jnp.zeros((1, 8), jnp.int32))
         self.params = jax.device_put(params)
+        if cfg.speculate > 0 and cfg.paged:
+            # checked BEFORE the page pool below: a config error must not
+            # cost a multi-GB HBM allocation first
+            raise ValueError(
+                "speculate requires paged=False: the paged decode kernel "
+                "is single-position; the dense cache path verifies [B, K+1] "
+                "windows natively (set paged=False or speculate=0)")
         if cfg.paged:
             from ray_tpu.ops.paged_attention import PagedKVCache, PageManager
             mc = self.model_cfg
@@ -144,6 +171,10 @@ class LLMServer:
             self.page_mgr = None
             self.cache = KVCache.init(self.model_cfg, B, cfg.max_seq_len)
         self._active: Dict[int, _Slot] = {}   # slot idx -> request state
+        # speculative-decoding accounting (stats()/serving bench)
+        self._spec = None
+        self._spec_stats = {"spec_ticks": 0, "decode_ticks": 0,
+                            "drafted": 0, "accepted": 0}
         self._free = list(range(B))
         self._req_counter = 0
         self._tick_task = None
@@ -268,6 +299,46 @@ class LLMServer:
             new_cache = KVCache(k=new_cache.k, v=new_cache.v, length=length)
             return new_cache, nxt, logp
 
+        def spec_step(params, cache, tokens, active_mask, key,
+                      temps, top_ps, top_ks, want_logp):
+            """Verify K drafts + emit a bonus token in ONE [B, K+1] forward.
+
+            tokens[:, 0] is each slot's last emitted token (its KV is
+            written at the row's length, same lag-by-one contract as
+            decode_step); tokens[:, 1:] are prompt-lookup drafts. Greedy
+            targets tgt[:, j] = argmax of position j's logits; draft j+1
+            is accepted iff it equals tgt[:, j], so every accepted token
+            IS the token step-by-step greedy decode would have produced
+            — exactness is structural, not probabilistic. n_emit =
+            accepted run + 1 bonus for greedy slots; sampled slots take
+            position 0 through the unchanged sample() policy and advance
+            by one. Row lengths advance by n_emit, so KV written for
+            rejected positions sits past `length`: masked on read
+            (decode_attention's absolute-position mask) and overwritten
+            by the next tick's [length, length+K] write before it can
+            ever become readable."""
+            logits, new_cache = model.apply(params, tokens, cache=cache)
+            logits = logits.astype(jnp.float32)
+            nxt0, logp0 = sample(logits[:, 0, :], key, temps, top_ps,
+                                 top_ks, want_logp)
+            tgt = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K+1]
+            greedy = temps <= 0.0
+            match = tokens[:, 1:] == tgt[:, :-1]                 # [B, K]
+            n_acc = jnp.cumprod(match.astype(jnp.int32),
+                                axis=-1).sum(axis=-1)
+            n_emit = jnp.where(greedy & active_mask, n_acc + 1, 1)
+            emit = tgt.at[:, 0].set(jnp.where(greedy, tgt[:, 0], nxt0))
+            if want_logp:
+                lp = jnp.take_along_axis(jax.nn.log_softmax(logits, -1),
+                                         emit[:, :, None], axis=-1)[..., 0]
+                lp = lp.at[:, 0].set(jnp.where(greedy, lp[:, 0], logp0))
+            else:
+                lp = jnp.zeros(emit.shape, jnp.float32)
+            length = jnp.where(active_mask, cache.length + n_emit,
+                               cache.length)
+            new_cache = KVCache(k=new_cache.k, v=new_cache.v, length=length)
+            return new_cache, emit, n_emit, lp
+
         if cfg.paged:
             self._prefill = jax.jit(prefill_paged, donate_argnums=(1,),
                                     static_argnums=(6,))
@@ -277,6 +348,9 @@ class LLMServer:
             self._prefill = jax.jit(prefill_row, donate_argnums=(1,))
             self._decode = jax.jit(decode_step, donate_argnums=(1,),
                                    static_argnums=(8,))
+            if cfg.speculate > 0:
+                self._spec = jax.jit(spec_step, donate_argnums=(1,),
+                                     static_argnums=(8,))
         # first token goes through the SAME sampling policy as later ones
         self._sample_first = jax.jit(
             lambda logits, key, t, p, k, want_logp=True: tuple(
@@ -296,7 +370,8 @@ class LLMServer:
     # -- request admission ---------------------------------------------------
     def _make_slot(self, prompt_len: int, max_tokens: int,
                    eos_id: Optional[int], stream: bool, temperature,
-                   top_p, top_k, logprobs: bool) -> _Slot:
+                   top_p, top_k, logprobs: bool,
+                   prompt_ids: Optional[List[int]] = None) -> _Slot:
         """Single site for per-request state + sampling-default fallbacks —
         shared with the PD decode path (pd.py) so a new sampling knob can't
         silently diverge between colocated and disaggregated admission."""
@@ -310,7 +385,7 @@ class LLMServer:
                                   else temperature),
                      top_p=cfg.top_p if top_p is None else top_p,
                      top_k=cfg.top_k if top_k is None else top_k,
-                     want_logprobs=logprobs)
+                     want_logprobs=logprobs, prompt_ids=prompt_ids or [])
 
     async def _admit(self, prompt_ids: List[int], max_tokens: int,
                      eos_id: Optional[int], stream: bool,
@@ -322,7 +397,13 @@ class LLMServer:
         # feasibility (max_seq_len, page-pool capacity) raises in _reserve
         slot_idx, cached = await self._reserve(prompt_ids, P + max_tokens)
         slot = self._make_slot(P, max_tokens, eos_id, stream, temperature,
-                               top_p, top_k, logprobs)
+                               top_p, top_k, logprobs,
+                               # the retained copy feeds prompt-lookup
+                               # drafting only — don't hold every prompt
+                               # alive for the common speculate=0 config
+                               prompt_ids=(list(prompt_ids)
+                                           if self.config.speculate > 0
+                                           else None))
         # the engine feeds the prompt through in chunks, interleaved with
         # decode ticks for already-active slots (chunked prefill). A cached
         # prefix starts the job past the shared pages — their KV is already
@@ -430,6 +511,60 @@ class LLMServer:
         job.pos += n
         return last_logits if final else None
 
+    @staticmethod
+    def _lookup_draft(ctx: List[int], k: int, n: int) -> List[int]:
+        """Prompt-lookup draft: the continuation of the MOST RECENT earlier
+        occurrence of the context's final n-gram ([] when none). REFERENCE
+        implementation (unit-tested): the engine itself keeps an
+        incremental per-slot {n-gram -> continuation start} index with the
+        same most-recent-match semantics, O(1) per tick."""
+        L = len(ctx)
+        if L <= n:
+            return []
+        tail = ctx[-n:]
+        for i in range(L - n - 1, -1, -1):
+            if ctx[i:i + n] == tail:
+                return ctx[i + n:i + n + k]
+        return []
+
+    def _spec_drafts(self) -> Optional[Dict[int, List[int]]]:
+        """Decide whether THIS tick runs the speculative step. Returns
+        {slot: draft} when it should, None for a plain decode tick —
+        speculation needs K+1 free cache positions on every row it
+        touches (the verify forward writes K+1 entries unconditionally;
+        a clamped write would silently overwrite valid KV — the KVCache
+        capacity invariant), including rows still MID-PREFILL, and at
+        least one greedy slot with a real n-gram hit (a tick with no
+        usable draft would pay the (K+1)-position forward for nothing)."""
+        cfg = self.config
+        K = cfg.speculate
+        n = cfg.spec_ngram
+        if self._spec is None or not self._active:
+            return None
+        for job in self._prefill_q:
+            # a prefilling row's committed length is job.pos; the spec
+            # write lands K+1 entries there too
+            if job.pos + K + 1 > cfg.max_seq_len:
+                return None
+        drafts: Dict[int, List[int]] = {}
+        for i, slot in self._active.items():
+            if slot.prompt_len + len(slot.generated) + K + 1 > cfg.max_seq_len:
+                return None
+            if slot.temperature > 0:
+                continue
+            ctx = slot.ctx
+            if len(ctx) != slot.prompt_len + len(slot.generated):
+                # first spec tick for this slot (or a non-emit_one append
+                # happened, e.g. the prefill first-token): (re)build the
+                # incremental index once; emit_one keeps it current after
+                ctx = slot.ctx = slot.prompt_ids + slot.generated
+                slot.spec_index = {
+                    tuple(ctx[e - n:e]): e for e in range(n, len(ctx))}
+            pos = slot.spec_index.get(tuple(ctx[-n:]))
+            if pos is not None:
+                drafts[i] = ctx[pos:pos + K]
+        return drafts or None
+
     def _ensure_tick_loop(self):
         if self._tick_task is None or self._tick_task.done():
             self._tick_task = asyncio.get_running_loop().create_task(
@@ -480,15 +615,37 @@ class LLMServer:
         import jax.numpy as jnp
 
         B = self.config.max_batch_slots
+        K = self.config.speculate
+
+        n_gram = self.config.spec_ngram
+
+        def emit_one(slot: _Slot, tok: int, lp: float) -> bool:
+            """Append one token to `slot`; True when the slot is done."""
+            slot.generated.append(tok)
+            if slot.ctx:   # incremental prompt-lookup index maintenance
+                ctx = slot.ctx
+                ctx.append(tok)
+                L = len(ctx)
+                if L > n_gram:
+                    # the n-gram ending at L-2 gained a continuation (L-1)
+                    slot.spec_index[tuple(ctx[L - 1 - n_gram:L - 1])] = L - 1
+            if slot.want_logprobs:
+                slot.logprobs.append(lp)
+            if slot.stream_queue is not None:
+                slot.stream_queue.put_nowait(tok)
+            hit_eos = slot.eos_id is not None and tok == slot.eos_id
+            total = slot.prompt_len + len(slot.generated)
+            return (len(slot.generated) >= slot.max_tokens or hit_eos
+                    or total >= self.config.max_seq_len)
+
         while self._active or self._prefill_q:
             if self._active:
-                last = np.zeros((B, 1), np.int32)
+                drafts = self._spec_drafts()
                 mask = np.zeros((B,), bool)
                 temps = np.zeros((B,), np.float32)
                 top_ps = np.ones((B,), np.float32)
                 top_ks = np.zeros((B,), np.int32)
                 for i, slot in self._active.items():
-                    last[i, 0] = slot.generated[-1]
                     mask[i] = True
                     temps[i] = slot.temperature
                     top_ps[i] = slot.top_p
@@ -496,25 +653,50 @@ class LLMServer:
                 any_logp = any(s.want_logprobs
                                for s in self._active.values())
                 self._sample_key, sub = jax.random.split(self._sample_key)
-                self.cache, nxt, logp = self._decode(
-                    self.params, self.cache, jnp.asarray(last),
-                    jnp.asarray(mask), sub, jnp.asarray(temps),
-                    jnp.asarray(top_ps), jnp.asarray(top_ks), any_logp)
-                nxt = np.asarray(jax.device_get(nxt))
-                logp = np.asarray(jax.device_get(logp))
                 finished = []
-                for i, slot in self._active.items():
-                    tok = int(nxt[i])
-                    slot.generated.append(tok)
-                    if slot.want_logprobs:
-                        slot.logprobs.append(float(logp[i]))
-                    if slot.stream_queue is not None:
-                        slot.stream_queue.put_nowait(tok)
-                    hit_eos = slot.eos_id is not None and tok == slot.eos_id
-                    total = slot.prompt_len + len(slot.generated)
-                    if (len(slot.generated) >= slot.max_tokens or hit_eos
-                            or total >= self.config.max_seq_len):
-                        finished.append(i)
+                if drafts is not None:
+                    # speculative tick: one [B, K+1] verify forward
+                    toks = np.zeros((B, K + 1), np.int32)
+                    for i, slot in self._active.items():
+                        toks[i, 0] = slot.generated[-1]
+                        d = drafts.get(i, [])
+                        toks[i, 1:1 + len(d)] = d
+                    self.cache, emit, n_emit, logp = self._spec(
+                        self.params, self.cache, jnp.asarray(toks),
+                        jnp.asarray(mask), sub, jnp.asarray(temps),
+                        jnp.asarray(top_ps), jnp.asarray(top_ks), any_logp)
+                    emit = np.asarray(jax.device_get(emit))
+                    n_emit = np.asarray(jax.device_get(n_emit))
+                    logp = np.asarray(jax.device_get(logp))
+                    st = self._spec_stats
+                    st["spec_ticks"] += 1
+                    st["drafted"] += sum(len(d) for d in drafts.values())
+                    for i, slot in self._active.items():
+                        cnt = int(n_emit[i])
+                        if i in drafts:
+                            # clip: a short draft's zero-padding can
+                            # "accidentally" match argmax (still exact
+                            # output) but must not count as acceptance
+                            st["accepted"] += min(cnt - 1, len(drafts[i]))
+                        for j in range(cnt):
+                            if emit_one(slot, int(emit[i, j]),
+                                        float(logp[i, j])):
+                                finished.append(i)
+                                break
+                else:
+                    last = np.zeros((B, 1), np.int32)
+                    for i, slot in self._active.items():
+                        last[i, 0] = slot.generated[-1]
+                    self.cache, nxt, logp = self._decode(
+                        self.params, self.cache, jnp.asarray(last),
+                        jnp.asarray(mask), sub, jnp.asarray(temps),
+                        jnp.asarray(top_ps), jnp.asarray(top_ks), any_logp)
+                    nxt = np.asarray(jax.device_get(nxt))
+                    logp = np.asarray(jax.device_get(logp))
+                    self._spec_stats["decode_ticks"] += 1
+                    for i, slot in self._active.items():
+                        if emit_one(slot, int(nxt[i]), float(logp[i])):
+                            finished.append(i)
                 for i in finished:
                     slot = self._active.pop(i)
                     slot.done_event.set()
@@ -644,6 +826,11 @@ class LLMServer:
     def stats(self) -> Dict[str, Any]:
         s = {"active": len(self._active), "free_slots": len(self._free),
              "requests": self._req_counter}
+        if self.config.speculate > 0:
+            st = dict(self._spec_stats)
+            st["accept_rate"] = round(
+                st["accepted"] / max(st["drafted"], 1), 4)
+            s["speculation"] = st
         if self.page_mgr is not None:
             mgr = self.page_mgr
             s["pages_in_use"] = mgr.pages_in_use

@@ -118,7 +118,10 @@ class DecodeServer(LLMServer):
             raise
         first = int(kv["token"])
         slot = self._make_slot(P, max_tokens, eos_id, stream, temperature,
-                               top_p, top_k, logprobs)
+                               top_p, top_k, logprobs,
+                               prompt_ids=(list(prompt)
+                                           if self.config.speculate > 0
+                                           else None))
         slot.generated.append(first)
         if logprobs and "logprob" in kv:
             slot.logprobs.append(float(kv["logprob"]))
