@@ -10,6 +10,7 @@ from .datasource import (from_arrow, from_items, from_numpy, from_pandas,
                          read_parquet, read_text)
 from .preprocessors import (BatchMapper, Chain, Concatenator, LabelEncoder,
                             MinMaxScaler, Preprocessor, StandardScaler)
+from .random_access import RandomAccessDataset
 from .readers import (read_images, read_tfrecords, read_webdataset,
                       write_tfrecords)
 from .split import DataIterator
@@ -20,5 +21,5 @@ __all__ = [
     "read_csv", "read_images", "read_json", "read_text", "read_binary_files",
     "read_tfrecords", "read_webdataset", "write_tfrecords", "Preprocessor",
     "BatchMapper", "StandardScaler", "MinMaxScaler", "LabelEncoder",
-    "Concatenator", "Chain",
+    "Concatenator", "Chain", "RandomAccessDataset",
 ]

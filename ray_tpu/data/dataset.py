@@ -683,6 +683,13 @@ class Dataset:
                                  prefetch_batches=0, drop_last=drop_last)
         return _idb(host, sharding=sharding, prefetch=prefetch)
 
+    def to_random_access_dataset(self, key: str, num_workers: int = 2):
+        """Distributed key→record lookup service over this dataset sorted
+        by `key` (ref: python/ray/data/dataset.py to_random_access_dataset;
+        see data/random_access.py for the re-design notes)."""
+        from .random_access import RandomAccessDataset
+        return RandomAccessDataset(self, key, num_workers=num_workers)
+
     # ---------------------------------------------------------------- writes
     # Paths may be plain local paths OR filesystem URIs (file://, gs://,
     # s3://, ...) — resolved through pyarrow.fs like the reference's

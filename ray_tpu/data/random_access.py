@@ -1,0 +1,171 @@
+"""Distributed random access over a sorted Dataset.
+
+Reference parity: python/ray/data/random_access_dataset.py —
+`Dataset.to_random_access_dataset(key)` sorts by `key`, records per-block
+key bounds, and spreads the blocks over worker actors; `get_async` routes
+a key to the owning block's worker by bisect, the worker binary-searches
+inside the block (np.searchsorted). Re-design notes vs the reference:
+blocks ship to workers as object-store refs (zero extra driver copy
+beyond the sort), assignment is round-robin rather than
+object-location-driven (our store pulls cross-node on demand; the
+reference preassigns by physical block location), and `multiget` batches
+per owning worker with the same vectorized single-block fast path.
+"""
+
+import bisect
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["RandomAccessDataset"]
+
+
+def _worker_cls():
+    """Late-bound actor class (module import must not require a runtime)."""
+    import ray_tpu
+
+    @ray_tpu.remote
+    class _RandomAccessWorker:
+        def __init__(self, key_field: str):
+            self.key_field = key_field
+            self.blocks: Dict[int, Any] = {}
+            self.num_accesses = 0
+            self.total_time = 0.0
+
+        def assign_blocks(self, refs: Dict[int, Any]):
+            import ray_tpu as rt
+            self.blocks = dict(zip(refs.keys(), rt.get(list(refs.values()))))
+            return len(self.blocks)
+
+        def _find(self, block_index: int, key):
+            block = self.blocks[block_index]
+            col = block.column(self.key_field).to_numpy(zero_copy_only=False)
+            i = int(np.searchsorted(col, key))
+            if i < len(col) and col[i] == key:
+                return {c: block.column(c)[i].as_py()
+                        for c in block.column_names}
+            return None
+
+        def get(self, block_index: int, key):
+            t0 = time.perf_counter()
+            out = self._find(block_index, key)
+            self.total_time += time.perf_counter() - t0
+            self.num_accesses += 1
+            return out
+
+        def multiget(self, block_indices: List[int], keys: List[Any]):
+            t0 = time.perf_counter()
+            if len(set(block_indices)) == 1:
+                # vectorized single-block fast path (one searchsorted call)
+                block = self.blocks[block_indices[0]]
+                col = block.column(self.key_field) \
+                           .to_numpy(zero_copy_only=False)
+                idx = np.searchsorted(col, keys)
+                out = []
+                for i, k in zip(idx, keys):
+                    if i < len(col) and col[i] == k:
+                        out.append({c: block.column(c)[int(i)].as_py()
+                                    for c in block.column_names})
+                    else:
+                        out.append(None)
+            else:
+                out = [self._find(b, k)
+                       for b, k in zip(block_indices, keys)]
+            self.total_time += time.perf_counter() - t0
+            self.num_accesses += 1
+            return out
+
+        def stats(self) -> Dict[str, Any]:
+            return {"num_blocks": len(self.blocks),
+                    "num_accesses": self.num_accesses,
+                    "total_time": self.total_time}
+
+    return _RandomAccessWorker
+
+
+class RandomAccessDataset:
+    """Random key→record lookup over `ds` sorted by `key` (construct via
+    Dataset.to_random_access_dataset)."""
+
+    def __init__(self, ds, key: str, num_workers: int = 2):
+        import ray_tpu
+
+        t0 = time.perf_counter()
+        blocks = ds.sort(key).to_block_list()
+        self._key = key
+        # per-block [lower, upper] key bounds for the bisect routing table
+        self._non_empty: List[Any] = []
+        self._upper_bounds: List[Any] = []
+        self._lower_bound = None
+        for blk in blocks:
+            if blk.num_rows == 0:
+                continue
+            col = blk.column(key)
+            if self._lower_bound is None:
+                self._lower_bound = col[0].as_py()
+            self._non_empty.append(ray_tpu.put(blk))
+            self._upper_bounds.append(col[blk.num_rows - 1].as_py())
+        cls = _worker_cls()
+        n = max(1, min(num_workers, max(len(self._non_empty), 1)))
+        self._workers = [cls.remote(key) for _ in range(n)]
+        # round-robin block→worker assignment (see module docstring)
+        self._block_to_worker = {}
+        assign: Dict[Any, Dict[int, Any]] = {w: {} for w in self._workers}
+        for i, ref in enumerate(self._non_empty):
+            w = self._workers[i % n]
+            self._block_to_worker[i] = w
+            assign[w][i] = ref
+        ray_tpu.get([w.assign_blocks.remote(refs)
+                     for w, refs in assign.items()])
+        self._build_time = time.perf_counter() - t0
+
+    def _find_le(self, key) -> Optional[int]:
+        i = bisect.bisect_left(self._upper_bounds, key)
+        if i >= len(self._upper_bounds) or (self._lower_bound is not None
+                                            and key < self._lower_bound):
+            return None
+        return i
+
+    def get_async(self, key):
+        """ObjectRef of the record dict for `key` (None when absent)."""
+        import ray_tpu
+        i = self._find_le(key)
+        if i is None:
+            return ray_tpu.put(None)
+        return self._block_to_worker[i].get.remote(i, key)
+
+    def multiget(self, keys: List[Any]) -> List[Optional[Dict]]:
+        """Records for `keys` (None for misses), batched per owning
+        worker — order matches the input."""
+        import collections
+
+        import ray_tpu
+        per_worker = collections.defaultdict(lambda: ([], []))
+        for k in keys:
+            i = self._find_le(k)
+            if i is not None:
+                idxs, ks = per_worker[self._block_to_worker[i]]
+                idxs.append(i)
+                ks.append(k)
+        futures = {w: w.multiget.remote(idxs, ks)
+                   for w, (idxs, ks) in per_worker.items()}
+        found = {}
+        for w, fut in futures.items():
+            _, ks = per_worker[w]
+            for k, v in zip(ks, ray_tpu.get(fut)):
+                found[k] = v
+        return [found.get(k) for k in keys]
+
+    def stats(self) -> str:
+        import ray_tpu
+        stats = ray_tpu.get([w.stats.remote() for w in self._workers])
+        acc = sum(s["num_accesses"] for s in stats)
+        tot = sum(s["total_time"] for s in stats)
+        return ("RandomAccessDataset:\n"
+                f"- Build time: {self._build_time:.2f}s\n"
+                f"- Num workers: {len(stats)}\n"
+                f"- Blocks per worker: "
+                f"{[s['num_blocks'] for s in stats]}\n"
+                f"- Accesses: {acc}, mean access time: "
+                f"{int(tot / max(acc, 1) * 1e6)}us")

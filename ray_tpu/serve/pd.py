@@ -117,11 +117,11 @@ class DecodeServer(LLMServer):
             self._release_slot(slot_idx)
             raise
         first = int(kv["token"])
+        # prompt_ids=None: PD decode requires paged KV while speculation
+        # requires the dense cache, so prompt-lookup drafting can never be
+        # active on this path
         slot = self._make_slot(P, max_tokens, eos_id, stream, temperature,
-                               top_p, top_k, logprobs,
-                               prompt_ids=(list(prompt)
-                                           if self.config.speculate > 0
-                                           else None))
+                               top_p, top_k, logprobs, prompt_ids=None)
         slot.generated.append(first)
         if logprobs and "logprob" in kv:
             slot.logprobs.append(float(kv["logprob"]))

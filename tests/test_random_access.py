@@ -1,0 +1,44 @@
+"""RandomAccessDataset (ref: python/ray/data/random_access_dataset.py):
+sorted-block routing, worker-side binary search, batched multiget."""
+
+import numpy as np
+import pytest
+
+from ray_tpu import data as rd
+
+
+@pytest.fixture(scope="module")
+def rad(ray_session):
+    rng = np.random.default_rng(0)
+    keys = rng.permutation(60)
+    ds = rd.from_items([{"k": int(k), "v": int(k) * 10} for k in keys])
+    return ds.repartition(5).to_random_access_dataset("k", num_workers=2)
+
+
+def test_get_async_hit_and_miss(rad, ray_session):
+    import ray_tpu
+    assert ray_tpu.get(rad.get_async(17)) == {"k": 17, "v": 170}
+    assert ray_tpu.get(rad.get_async(0)) == {"k": 0, "v": 0}
+    assert ray_tpu.get(rad.get_async(59)) == {"k": 59, "v": 590}
+    assert ray_tpu.get(rad.get_async(-5)) is None    # below lower bound
+    assert ray_tpu.get(rad.get_async(1000)) is None  # above upper bound
+
+
+def test_multiget_order_and_misses(rad, ray_session):
+    keys = [3, 999, 41, -1, 12, 12]
+    out = rad.multiget(keys)
+    assert out[0] == {"k": 3, "v": 30}
+    assert out[1] is None
+    assert out[2] == {"k": 41, "v": 410}
+    assert out[3] is None
+    assert out[4] == out[5] == {"k": 12, "v": 120}
+
+
+def test_multiget_all_keys(rad, ray_session):
+    out = rad.multiget(list(range(60)))
+    assert all(out[i] == {"k": i, "v": i * 10} for i in range(60))
+
+
+def test_stats_renders(rad, ray_session):
+    s = rad.stats()
+    assert "Num workers: 2" in s
