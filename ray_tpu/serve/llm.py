@@ -67,6 +67,16 @@ class LLMConfig:
     # tick from the unchanged position-0 sampler.
     speculate: int = 0              # K draft tokens per tick (0 = off)
     spec_ngram: int = 3             # n-gram length for the prompt lookup
+    # Tensor-parallel serving (BASELINE config #3: one inference replica
+    # spanning a v5e-8 slice). tp>1 builds a {"tp": tp} mesh, shards
+    # params with the canonical llama_rules (attention heads + ffn over
+    # tp) and the KV cache on its kv-head axis, then lets GSPMD partition
+    # the SAME jitted prefill/decode programs — XLA inserts the
+    # all-reduces where wo/w_down contract the tp axis; no per-op
+    # collectives in this file. Dense cache only: the paged pallas
+    # kernel would need an explicit shard_map, the dense path is pure
+    # XLA and auto-partitions.
+    tp: int = 1
     # extra LlamaConfig kwargs applied over the preset (e.g. vocab_size for
     # a tokenizer whose id space outgrows the preset's)
     model_overrides: Optional[Dict[str, Any]] = None
@@ -146,10 +156,42 @@ class LLMServer:
         self.model = Llama(self.model_cfg)
         B = cfg.max_batch_slots
         key = jax.random.PRNGKey(cfg.seed)
-        if params is None:
-            params = self.model.init(
-                key, jnp.zeros((1, 8), jnp.int32))
-        self.params = jax.device_put(params)
+        if cfg.tp > 1:
+            if cfg.paged:
+                raise ValueError(
+                    "tp>1 requires paged=False: the paged pallas kernel "
+                    "does not auto-partition under GSPMD (dense decode "
+                    "attention does)")
+            if self.model_cfg.n_kv_heads % cfg.tp:
+                raise ValueError(
+                    f"tp={cfg.tp} must divide n_kv_heads="
+                    f"{self.model_cfg.n_kv_heads} (the KV cache shards on "
+                    f"its kv-head axis)")
+            from ray_tpu.parallel.mesh import make_mesh
+            from ray_tpu.parallel.sharding import llama_rules, shard_tree
+            if cfg.tp > len(jax.devices()):
+                raise ValueError(f"tp={cfg.tp} but only "
+                                 f"{len(jax.devices())} devices visible")
+            self.mesh = make_mesh({"tp": cfg.tp},
+                                  devices=jax.devices()[:cfg.tp])
+            if params is None:
+                # born sharded: tp exists for models that do NOT fit one
+                # chip, so init must never materialize the full tree on
+                # device 0 first — jit with out_shardings allocates each
+                # shard on its owner directly
+                dummy = jnp.zeros((1, 8), jnp.int32)
+                abstract = jax.eval_shape(self.model.init, key, dummy)
+                shardings = llama_rules().tree_shardings(abstract, self.mesh)
+                self.params = jax.jit(self.model.init,
+                                      out_shardings=shardings)(key, dummy)
+            else:
+                # host → per-shard transfers (no single-device staging)
+                self.params = shard_tree(params, self.mesh, llama_rules())
+        else:
+            self.mesh = None
+            if params is None:
+                params = self.model.init(key, jnp.zeros((1, 8), jnp.int32))
+            self.params = jax.device_put(params)
         if cfg.speculate > 0 and cfg.paged:
             # checked BEFORE the page pool below: a config error must not
             # cost a multi-GB HBM allocation first
@@ -169,7 +211,24 @@ class LLMServer:
                 cfg.page_size, B, max_pages, dtype=mc.dtype)
         else:
             self.page_mgr = None
-            self.cache = KVCache.init(self.model_cfg, B, cfg.max_seq_len)
+            if self.mesh is not None:
+                # born sharded on the kv-head axis ([B, Smax, Kh, D]) to
+                # match the tp-sharded wk/wv projections — KV for a head
+                # never crosses chips, and the full-size cache is never
+                # staged on one device (same OOM argument as params)
+                from jax.sharding import NamedSharding, PartitionSpec
+                kv_s = NamedSharding(self.mesh,
+                                     PartitionSpec(None, None, "tp", None))
+                rep = NamedSharding(self.mesh, PartitionSpec())
+                abstract = jax.eval_shape(
+                    lambda: KVCache.init(self.model_cfg, B, cfg.max_seq_len))
+                out_sh = jax.tree_util.tree_map(
+                    lambda leaf: kv_s if leaf.ndim == 4 else rep, abstract)
+                self.cache = jax.jit(
+                    lambda: KVCache.init(self.model_cfg, B, cfg.max_seq_len),
+                    out_shardings=out_sh)()
+            else:
+                self.cache = KVCache.init(self.model_cfg, B, cfg.max_seq_len)
         self._active: Dict[int, _Slot] = {}   # slot idx -> request state
         # speculative-decoding accounting (stats()/serving bench)
         self._spec = None
