@@ -468,6 +468,24 @@ def orchestrate():
     if result is None:
         _log("bench: all attempts failed")
         sys.exit(1)
+    # Late-recovery retry (r5: observed live): a wedged TPU relay often
+    # answers again within minutes. If the ladder fell back to CPU and the
+    # budget still has room for one full TPU attempt (watchdog + compile +
+    # measure), wait a beat and re-try the flagship rung — a TPU headline
+    # recorded 10 minutes late beats a CPU number recorded on time.
+    # Gate: after the 240s wait, _run_child still subtracts its 400s
+    # scrub reserve from the timeout — so anything under ~1300s remaining
+    # leaves the retry child too little time to compile+measure (the rung
+    # is budgeted 1500s) and the wait would be pure loss.
+    if result.get("backend") == "cpu" and _remaining() > 1300:
+        wait = 240.0
+        _log(f"bench: CPU fallback in hand; waiting {wait:.0f}s for the "
+             f"relay, then retrying the TPU rung once")
+        time.sleep(wait)
+        retry, _reason = _run_child("llama_1b", cpu_scrub=False)
+        if retry is not None and retry.get("backend") != "cpu":
+            _log("bench: late TPU retry succeeded; replacing CPU record")
+            result = retry
     prior = _prior_value(result["metric"])
     result["vs_baseline"] = round(result["value"] / prior, 3) if prior else 1.0
     # EARLY EMIT: the headline is on stdout before any aux bench runs — a

@@ -30,6 +30,7 @@ from .api import (
     timeline,
     wait,
 )
+from .logging_config import LoggingConfig
 from .remote_function import RemoteFunction
 from .runtime_context import get_runtime_context, get_tpu_ids
 from . import exceptions
@@ -40,7 +41,8 @@ __all__ = [
     "DynamicObjectRefGenerator", "RemoteFunction",
     "available_resources", "cancel", "cluster_address", "cluster_resources", "exceptions",
     "exit_actor", "get", "get_actor", "get_runtime_context", "get_tpu_ids",
-    "init", "is_initialized", "kill", "method", "nodes", "object_ref_from_id", "put", "remote",
+    "init", "is_initialized", "kill", "LoggingConfig", "method", "nodes",
+    "object_ref_from_id", "put", "remote",
     "shutdown", "timeline", "wait",
 ]
 

@@ -185,6 +185,11 @@ def main():
     import faulthandler
     import signal
     faulthandler.register(signal.SIGUSR1, all_threads=True)
+    # structured logging: the driver published its LoggingConfig via env
+    # (ref: python/ray/_private/ray_logging/logging_config.py applied in
+    # default_worker.py)
+    from ray_tpu.logging_config import apply_from_env
+    apply_from_env()
     # runtime_env working_dir: the controller staged a copy and points us at
     # it (ref: working_dir semantics in python/ray/_private/runtime_env)
     wd = os.environ.get("RAY_TPU_WORKING_DIR")

@@ -51,7 +51,8 @@ def is_initialized() -> bool:
 
 def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
          object_store_memory=None, ignore_reinit_error=False, max_workers=None,
-         address=None, session_name=None, cluster_port=None, **_compat):
+         address=None, session_name=None, cluster_port=None,
+         logging_config=None, **_compat):
     """Start the ray_tpu runtime in this process (the driver), or — with
     `address` — ATTACH to a session another process started (reference:
     ray.init(address="auto") / address=<endpoint>). `address` is the
@@ -73,6 +74,15 @@ def init(num_cpus=None, num_tpus=None, resources=None, namespace=None,
             if ignore_reinit_error:
                 return
             raise RuntimeError("ray_tpu.init() called twice; pass ignore_reinit_error=True.")
+        if logging_config is not None:
+            # configure the driver AND publish for every worker this
+            # session spawns (workers inherit the driver's environ)
+            logging_config.publish_to_env()
+            logging_config.apply()
+        else:
+            # a PREVIOUS session's published config must not leak into
+            # this one's workers (init→shutdown→init without the kwarg)
+            os.environ.pop("RAY_TPU_LOGGING_CONFIG", None)
         if address is not None:
             sock = os.environ.get("RAY_TPU_ADDRESS") if address == "auto" else address
             if not sock or not os.path.exists(sock):

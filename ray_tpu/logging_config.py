@@ -1,0 +1,108 @@
+"""Structured worker logging (reference:
+python/ray/_private/ray_logging/logging_config.py LoggingConfig).
+
+`ray_tpu.init(logging_config=LoggingConfig(encoding="JSON",
+log_level="DEBUG"))` configures the root logger in the driver AND every
+worker the controller spawns for this session: the config rides an env
+var that worker processes inherit (`_spawn_worker` copies the driver's
+environ), so the reference's dedicated log-configurator plumbing
+collapses to one json round-trip. TEXT keeps a conventional one-line
+format with the worker id prefixed; JSON emits one object per record
+for log pipelines.
+"""
+
+import dataclasses
+import json
+import logging
+import os
+from typing import Tuple
+
+_ENV = "RAY_TPU_LOGGING_CONFIG"
+_VALID_ENCODINGS = ("TEXT", "JSON")
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per record: asctime/levelname/name/message plus any
+    `additional_log_standard_attrs` and the worker id when present."""
+
+    def __init__(self, additional: Tuple[str, ...] = ()):
+        super().__init__()
+        self.additional = tuple(additional)
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "asctime": self.formatTime(record),
+            "levelname": record.levelname,
+            "name": record.name,
+            "message": record.getMessage(),
+        }
+        wid = os.environ.get("RAY_TPU_WORKER_ID")
+        if wid:
+            out["worker_id"] = wid
+        for attr in self.additional:
+            out[attr] = getattr(record, attr, None)
+        if record.exc_info:
+            out["exc_text"] = self.formatException(record.exc_info)
+        return json.dumps(out)
+
+
+@dataclasses.dataclass
+class LoggingConfig:
+    encoding: str = "TEXT"
+    log_level: str = "INFO"
+    additional_log_standard_attrs: Tuple[str, ...] = ()
+
+    def __post_init__(self):
+        if self.encoding not in _VALID_ENCODINGS:
+            raise ValueError(f"encoding must be one of {_VALID_ENCODINGS}, "
+                             f"got {self.encoding!r}")
+        self.additional_log_standard_attrs = tuple(
+            self.additional_log_standard_attrs)
+
+    # -- env round-trip (driver -> spawned workers) -------------------------
+    def to_env(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @classmethod
+    def from_env(cls):
+        raw = os.environ.get(_ENV)
+        if not raw:
+            return None
+        try:
+            return cls(**json.loads(raw))
+        except (ValueError, TypeError):
+            return None  # a corrupt env var must not kill the worker
+
+    def publish_to_env(self):
+        os.environ[_ENV] = self.to_env()
+
+    # -- application --------------------------------------------------------
+    def _formatter(self) -> logging.Formatter:
+        if self.encoding == "JSON":
+            return JsonFormatter(self.additional_log_standard_attrs)
+        wid = os.environ.get("RAY_TPU_WORKER_ID")
+        prefix = f"({wid}) " if wid else ""
+        return logging.Formatter(
+            prefix + "%(asctime)s %(levelname)s %(name)s: %(message)s")
+
+    def apply(self):
+        """Install on the root logger (idempotent: replaces a previously
+        installed ray_tpu handler instead of stacking a second one)."""
+        root = logging.getLogger()
+        for h in list(root.handlers):
+            if getattr(h, "_ray_tpu_logging", False):
+                root.removeHandler(h)
+        handler = logging.StreamHandler()
+        handler._ray_tpu_logging = True
+        handler.setFormatter(self._formatter())
+        handler.setLevel(self.log_level)
+        root.addHandler(handler)
+        root.setLevel(self.log_level)
+
+
+def apply_from_env():
+    """Worker-side hook: configure logging when the driver published a
+    config (called from worker_main before any task runs)."""
+    cfg = LoggingConfig.from_env()
+    if cfg is not None:
+        cfg.apply()

@@ -5,11 +5,13 @@ Deployments are async replica actors; handles route with power-of-two-choices;
 adds a continuous-batching LLM replica on a jitted decode step.
 """
 
-from .api import (delete, get_deployment_handle, grpc_port, run,
-                  shutdown, start, status)
+from .api import (delete, get_app_handle, get_deployment_handle,
+                  get_replica_context, grpc_port, run, shutdown, start,
+                  status)
 from .asgi import ingress
 from .batching import batch
-from .deployment import AutoscalingConfig, Deployment, DeploymentConfig, deployment
+from .deployment import (Application, AutoscalingConfig, Deployment,
+                         DeploymentConfig, deployment)
 from .handle import DeploymentHandle, DeploymentResponse
 from .multiplex import get_multiplexed_model_id, multiplexed
 from .openai_api import ByteTokenizer, OpenAIIngress, build_openai_app
@@ -20,7 +22,8 @@ from .schema import build_app_config, deploy_config
 __all__ = [
     "AutoscalingConfig", "Deployment", "DeploymentConfig", "DeploymentHandle",
     "DeploymentResponse", "Request", "Response", "batch", "build_app_config",
-    "delete", "deploy_config", "deployment", "get_deployment_handle",
+    "Application", "delete", "deploy_config", "deployment",
+    "get_app_handle", "get_deployment_handle", "get_replica_context",
     "grpc_port",
     "get_multiplexed_model_id", "ingress", "multiplexed", "run", "shutdown",
     "start", "status", "PrefillServer", "DecodeServer", "PDServer",

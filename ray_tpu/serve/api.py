@@ -123,6 +123,26 @@ def get_deployment_handle(deployment_name: str,
     return DeploymentHandle(deployment_name, app_name)
 
 
+def get_app_handle(name: str = "default") -> DeploymentHandle:
+    """Handle to a running app's INGRESS deployment (ref:
+    python/ray/serve/api.py get_app_handle) — resolved through the
+    controller's route table, so the caller needn't know which deployment
+    is the root."""
+    import ray_tpu
+    ctrl = get_controller()
+    for _prefix, (app, ingress, _streaming) in ray_tpu.get(
+            ctrl.get_routes.remote()).items():
+        if app == name:
+            return DeploymentHandle(ingress, app)
+    raise ValueError(f"no running serve application named {name!r}")
+
+
+def get_replica_context():
+    """Inside a replica: who am I (app, deployment, replica tag)."""
+    from .replica import get_replica_context as _grc
+    return _grc()
+
+
 def shutdown() -> None:
     import ray_tpu
     if not ray_tpu.is_initialized():

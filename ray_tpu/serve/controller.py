@@ -98,7 +98,8 @@ class ServeController:
             actor_cls = ray_tpu.remote(**opts)(Replica)
             args, kwargs = rec["init"]
             replicas.append(actor_cls.remote(rec["blob"], args, kwargs,
-                                             cfg.user_config))
+                                             cfg.user_config,
+                                             (app, name, f"{name}#{idx}")))
         doomed = []
         while len(replicas) > target:
             doomed.append(replicas.pop())

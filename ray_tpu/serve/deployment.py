@@ -106,3 +106,9 @@ def deployment(cls_or_fn=None, *, name=None, num_replicas=None,
     if cls_or_fn is not None:
         return wrap(cls_or_fn)
     return wrap
+
+
+# What `.bind(...)` returns; the reference exports the same concept as
+# `serve.Application` (python/ray/serve/api.py) for type annotations in
+# app-builder functions.
+Application = BoundDeployment

@@ -6,13 +6,40 @@ actor's asyncio loop gives intra-replica concurrency up to
 max_ongoing_requests, and `@serve.batch` methods coalesce on that loop.
 """
 
+import dataclasses
 import inspect
+from typing import Optional
+
+
+@dataclasses.dataclass
+class ReplicaContext:
+    """What serve.get_replica_context() returns inside a replica
+    (ref: python/ray/serve/context.py ReplicaContext)."""
+    app_name: str
+    deployment: str
+    replica_tag: str
+
+
+_replica_context: Optional[ReplicaContext] = None
+
+
+def get_replica_context() -> ReplicaContext:
+    if _replica_context is None:
+        raise RuntimeError(
+            "get_replica_context() may only be called from within a "
+            "deployment replica (ref: serve.get_replica_context)")
+    return _replica_context
 
 
 class Replica:
     def __init__(self, cls_blob_or_cls, init_args, init_kwargs,
-                 user_config=None):
+                 user_config=None, context=None):
         import cloudpickle
+        if context is not None:
+            # set BEFORE the user's __init__ runs so the constructor can
+            # already ask who it is
+            global _replica_context
+            _replica_context = ReplicaContext(*context)
         cls = (cloudpickle.loads(cls_blob_or_cls)
                if isinstance(cls_blob_or_cls, bytes) else cls_blob_or_cls)
         if inspect.isclass(cls):

@@ -6,14 +6,18 @@ FailureConfig, Checkpoint, Result, and the in-loop session API
 """
 
 from .checkpoint import Checkpoint
-from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .config import (TRAIN_DATASET_KEY, BackendConfig, CheckpointConfig,
+                     DataConfig, FailureConfig, RunConfig, ScalingConfig,
+                     SyncConfig, TrainingFailedError)
 from .ingest import iter_device_batches, prefetch_iterator
 from .session import (TrainContext, TrainingStopped, get_checkpoint,
                       get_context, get_dataset_shard, report)
 from .trainer import JaxTrainer, Result
 
 __all__ = [
-    "Checkpoint", "CheckpointConfig", "FailureConfig", "RunConfig",
+    "BackendConfig", "Checkpoint", "CheckpointConfig", "DataConfig",
+    "FailureConfig", "RunConfig", "SyncConfig", "TRAIN_DATASET_KEY",
+    "TrainingFailedError",
     "ScalingConfig", "JaxTrainer", "Result", "TrainContext",
     "TrainingStopped", "report", "get_checkpoint", "get_context",
     "get_dataset_shard", "iter_device_batches", "prefetch_iterator",

@@ -86,3 +86,43 @@ class RunConfig:
         path = os.path.join(base, name)
         os.makedirs(path, exist_ok=True)
         return path
+
+
+@dataclasses.dataclass
+class BackendConfig:
+    """Base class for backend-specific setup (ref: train/backend.py
+    BackendConfig). The jax backend needs no per-worker process-group
+    setup beyond what JaxTrainer already does (jax.distributed), so this
+    exists for API-compatible subclassing."""
+
+
+@dataclasses.dataclass
+class DataConfig:
+    """Which datasets split across train workers vs replicate (ref:
+    train/_internal/data_config.py DataConfig). streaming_split handles
+    the actual sharding; "all" splits every dataset."""
+    datasets_to_split: object = "all"   # "all" | list of dataset names
+
+    def split_names(self, names):
+        if self.datasets_to_split == "all":
+            return list(names)
+        return [n for n in names if n in set(self.datasets_to_split)]
+
+
+@dataclasses.dataclass
+class SyncConfig:
+    """Checkpoint/artifact sync settings (ref: train/_internal/syncer.py).
+    Local + cloud-fs paths already go through pyarrow.fs in Checkpoint;
+    these knobs gate artifact syncing."""
+    sync_artifacts: bool = False
+    sync_period: int = 300
+
+
+TRAIN_DATASET_KEY = "train"
+
+
+class TrainingFailedError(RuntimeError):
+    """Raised/recorded when a training run fails permanently (ref:
+    ray.train.base_trainer.TrainingFailedError). JaxTrainer.fit returns
+    the failure in Result.error rather than raising — wrap it in this
+    type when a raising API is needed."""

@@ -5,24 +5,30 @@ schedulers (ASHA/HyperBand/median/PBT) act on intermediate results.
 `tune.report` is the same session API as `train.report`.
 """
 
+from ray_tpu.train import (Checkpoint, CheckpointConfig, FailureConfig,
+                           Result, RunConfig)
 from ray_tpu.train.session import get_checkpoint, get_context, report
 from .schedulers import (ASHAScheduler, FIFOScheduler, HyperBandScheduler,
                          MedianStoppingRule, PopulationBasedTraining,
                          TrialScheduler)
 from .search import (BasicVariantGenerator, ConcurrencyLimiter,
                      QuasiBayesSearch, Searcher)
-from .search_space import (choice, grid_search, loguniform, qrandint,
+from .search_space import (choice, grid_search, lograndint, loguniform,
+                           qlograndint, qloguniform, qrandint, qrandn,
                            quniform, randint, randn, sample_from, uniform)
 from .stopper import (CombinedStopper, FunctionStopper,
                       MaximumIterationStopper, Stopper, TrialPlateauStopper)
-from .tuner import (ResultGrid, TrialResult, TuneConfig, Tuner,
-                    with_resources)
+from .tuner import (ResultGrid, TrialResult, TuneConfig, TuneError,
+                    Tuner, with_parameters, with_resources)
 
 __all__ = [
-    "Tuner", "TuneConfig", "ResultGrid", "TrialResult", "with_resources",
+    "Tuner", "TuneConfig", "TuneError", "ResultGrid", "TrialResult",
+    "with_resources", "with_parameters", "Checkpoint", "CheckpointConfig",
+    "FailureConfig", "Result", "RunConfig",
     "report", "get_checkpoint", "get_context",
-    "choice", "uniform", "quniform", "loguniform", "randint", "qrandint",
-    "randn", "sample_from", "grid_search",
+    "choice", "uniform", "quniform", "loguniform", "qloguniform",
+    "randint", "qrandint", "lograndint", "qlograndint", "randn", "qrandn",
+    "sample_from", "grid_search",
     "BasicVariantGenerator", "ConcurrencyLimiter", "QuasiBayesSearch",
     "Searcher", "TrialScheduler", "FIFOScheduler", "ASHAScheduler",
     "HyperBandScheduler", "MedianStoppingRule", "PopulationBasedTraining",

@@ -120,3 +120,45 @@ def grid_search(values) -> Dict[str, List]:
 
 def is_grid(v) -> bool:
     return isinstance(v, dict) and set(v.keys()) == {"grid_search"}
+
+
+class LogRandint(Domain):
+    """Integer drawn log-uniformly (ref: sample.py lograndint)."""
+
+    def __init__(self, low: int, high: int, base: float = 10.0):
+        if low <= 0:
+            raise ValueError("lograndint needs low > 0")
+        self.low, self.high, self.base = low, high, base
+
+    def sample(self, rng):
+        lo = math.log(self.low, self.base)
+        hi = math.log(self.high, self.base)
+        return int(self.base ** rng.uniform(lo, hi))
+
+
+class Quantized(Domain):
+    """round(inner/q)*q — the ONE quantization wrapper behind every q*
+    sampler (matches QRandint's no-clamping convention); `cast` keeps the
+    inner domain's value type."""
+
+    def __init__(self, inner: Domain, q, cast=float):
+        self.inner, self.q, self.cast = inner, q, cast
+
+    def sample(self, rng):
+        return self.cast(round(self.inner.sample(rng) / self.q) * self.q)
+
+
+def lograndint(low, high, base: float = 10.0) -> LogRandint:
+    return LogRandint(low, high, base)
+
+
+def qlograndint(low, high, q=1, base: float = 10.0) -> Quantized:
+    return Quantized(LogRandint(low, high, base), q, cast=int)
+
+
+def qloguniform(low, high, q) -> Quantized:
+    return Quantized(LogUniform(low, high), q)
+
+
+def qrandn(mean: float = 0.0, sd: float = 1.0, q: float = 1.0) -> Quantized:
+    return Quantized(Randn(mean, sd), q)

@@ -157,6 +157,30 @@ def with_resources(trainable: Callable, resources: Dict[str, float]):
     return trainable
 
 
+def with_parameters(trainable: Callable, **kwargs):
+    """Bind large constant objects to a trainable (ref:
+    python/ray/tune/trainable/util.py with_parameters): the objects go to
+    the object store ONCE; every trial's wrapper pulls them by ref instead
+    of re-pickling them into each trial actor's creation spec."""
+    import functools
+
+    import ray_tpu
+    refs = {k: ray_tpu.put(v) for k, v in kwargs.items()}
+
+    @functools.wraps(trainable)
+    def wrapped(config):
+        resolved = {k: ray_tpu.get(r) for k, r in refs.items()}
+        return trainable(config, **resolved)
+
+    if hasattr(trainable, "_tune_resources"):
+        wrapped._tune_resources = trainable._tune_resources
+    return wrapped
+
+
+class TuneError(RuntimeError):
+    """Raised for tune-level failures (ref: ray.tune.TuneError)."""
+
+
 class Tuner:
     def __init__(self, trainable: Callable, *, param_space: Optional[Dict] = None,
                  tune_config: Optional[TuneConfig] = None,

@@ -25,6 +25,7 @@ _LAZY = {
     "metrics": ".metrics",
     "tpu": ".tpu",
     "state": ".state",
+    "inspect_serializability": ".check_serialize",
 }
 
 
@@ -34,4 +35,8 @@ def __getattr__(name):
         raise AttributeError(name)
     import importlib
     mod = importlib.import_module(mod_path, __name__)
-    return getattr(mod, name) if hasattr(mod, name) and name[0].isupper() else mod
+    # submodule names ("metrics", "tpu") resolve to the module itself;
+    # class/function names resolve to the attribute inside it
+    if hasattr(mod, name) and mod.__name__.rsplit(".", 1)[-1] != name:
+        return getattr(mod, name)
+    return mod

@@ -221,3 +221,51 @@ def test_end_to_end_fake_hang_falls_to_cpu_scrub():
     assert rec["value"] > 0
     # 2 watchdog kills (~3s each) + CPU measure; far under the r4 2×1500s
     assert elapsed < 540
+
+
+def test_late_tpu_retry_replaces_cpu_fallback(monkeypatch, capsys):
+    """r5 (observed live): the relay wedges, the ladder records a CPU
+    number, the relay recovers minutes later. With budget left the
+    orchestrator must retry the TPU rung once and prefer its record."""
+    monkeypatch.setattr(bench, "_kill_stale_workers", lambda: None)
+    monkeypatch.setattr(bench, "_sweep_orphan_shm", lambda: None)
+    monkeypatch.setattr(bench, "run_ladder",
+                        lambda: {"metric": "m", "value": 50.0,
+                                 "backend": "cpu"})
+    monkeypatch.setattr(bench, "_prior_value", lambda m: None)
+    monkeypatch.setattr(bench, "_remaining", lambda: 1400.0)
+    slept = []
+    monkeypatch.setattr(bench.time, "sleep", lambda s: slept.append(s))
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda cfg, cpu_scrub=False: ({"metric": "m", "value": 20000.0,
+                                       "backend": "tpu"}, None))
+    monkeypatch.setenv("RAY_TPU_BENCH_TRAIN_ONLY", "1")
+    bench.orchestrate()
+    lines = [json.loads(l)
+             for l in capsys.readouterr().out.strip().splitlines()]
+    assert lines[-1]["backend"] == "tpu" and lines[-1]["value"] == 20000.0
+    assert slept and slept[0] <= 240
+
+
+def test_late_tpu_retry_skipped_without_budget(monkeypatch, capsys):
+    """1100s remaining is NOT enough: after the 240s wait and the child's
+    400s scrub reserve only ~460s of child time remains vs the rung's
+    1500s budget — the retry must be skipped, not attempted futilely."""
+    monkeypatch.setattr(bench, "_kill_stale_workers", lambda: None)
+    monkeypatch.setattr(bench, "_sweep_orphan_shm", lambda: None)
+    monkeypatch.setattr(bench, "run_ladder",
+                        lambda: {"metric": "m", "value": 50.0,
+                                 "backend": "cpu"})
+    monkeypatch.setattr(bench, "_prior_value", lambda m: None)
+    monkeypatch.setattr(bench, "_remaining", lambda: 1100.0)
+
+    def boom(cfg, cpu_scrub=False):
+        raise AssertionError("retry must not run on a thin budget")
+
+    monkeypatch.setattr(bench, "_run_child", boom)
+    monkeypatch.setenv("RAY_TPU_BENCH_TRAIN_ONLY", "1")
+    bench.orchestrate()
+    lines = [json.loads(l)
+             for l in capsys.readouterr().out.strip().splitlines()]
+    assert lines[-1]["backend"] == "cpu"

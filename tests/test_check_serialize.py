@@ -1,0 +1,36 @@
+"""inspect_serializability (ref: python/ray/util/check_serialize.py):
+pinpoints the closure variable / attribute that breaks cloudpickle."""
+
+import threading
+
+
+def test_serializable_passes():
+    from ray_tpu.util import inspect_serializability
+    ok, failures = inspect_serializability(lambda x: x + 1)
+    assert ok and not failures
+
+
+def test_closure_culprit_named():
+    from ray_tpu.util import inspect_serializability
+    lock = threading.Lock()
+
+    def task():
+        return lock.acquire()
+
+    ok, failures = inspect_serializability(task, print_file=open("/dev/null", "w"))
+    assert not ok
+    assert any(f.name == "lock" for f in failures)
+
+
+def test_object_attribute_culprit_named():
+    from ray_tpu.util import inspect_serializability
+
+    class Holder:
+        def __init__(self):
+            self.fine = 42
+            self.bad = threading.Lock()
+
+    ok, failures = inspect_serializability(
+        Holder(), print_file=open("/dev/null", "w"))
+    assert not ok
+    assert any(f.name == "bad" for f in failures)

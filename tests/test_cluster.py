@@ -60,7 +60,13 @@ _EPILOGUE = textwrap.dedent("""
 
 def _run_driver(body: str, timeout=240):
     script = _PRELUDE + textwrap.dedent(body) + _EPILOGUE
-    env = dict(os.environ)
+    from ray_tpu.util.tpu import scrub_accel_env
+    # scrub the accelerator-plugin env (PALLAS_AXON_*): the driver
+    # subprocess compiles jax on CPU, and the image's sitecustomize
+    # plugin hook hangs first compile whenever the TPU relay is wedged
+    # (observed r5: this test timed out for exactly that reason while
+    # passing with a clean PYTHONPATH)
+    env = scrub_accel_env(dict(os.environ))
     env.pop("RAY_TPU_ARENA", None)
     env.pop("RAY_TPU_ADDRESS", None)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")

@@ -1,0 +1,125 @@
+"""LoggingConfig (ref: python/ray/_private/ray_logging/logging_config.py):
+driver + every spawned worker get the session's log encoding/level."""
+
+import json
+import logging
+
+import pytest
+
+
+def test_json_formatter_shape():
+    from ray_tpu.logging_config import JsonFormatter
+    rec = logging.LogRecord("my.logger", logging.WARNING, "f.py", 12,
+                            "hello %s", ("world",), None)
+    rec.job_id = "j-1"
+    out = json.loads(JsonFormatter(("job_id",)).format(rec))
+    assert out["levelname"] == "WARNING"
+    assert out["name"] == "my.logger"
+    assert out["message"] == "hello world"
+    assert out["job_id"] == "j-1"
+
+
+def test_env_round_trip(monkeypatch):
+    from ray_tpu.logging_config import LoggingConfig
+    cfg = LoggingConfig(encoding="JSON", log_level="DEBUG",
+                        additional_log_standard_attrs=("job_id",))
+    monkeypatch.setenv("RAY_TPU_LOGGING_CONFIG", cfg.to_env())
+    back = LoggingConfig.from_env()
+    assert back == cfg
+    monkeypatch.setenv("RAY_TPU_LOGGING_CONFIG", "{corrupt")
+    assert LoggingConfig.from_env() is None  # never kills a worker
+
+
+def test_invalid_encoding_rejected():
+    from ray_tpu.logging_config import LoggingConfig
+    with pytest.raises(ValueError, match="encoding"):
+        LoggingConfig(encoding="YAML")
+
+
+def test_apply_is_idempotent():
+    from ray_tpu.logging_config import LoggingConfig
+    root = logging.getLogger()
+    before = list(root.handlers)
+    try:
+        LoggingConfig(log_level="DEBUG").apply()
+        LoggingConfig(log_level="INFO").apply()
+        ours = [h for h in root.handlers
+                if getattr(h, "_ray_tpu_logging", False)]
+        assert len(ours) == 1
+        assert ours[0].level == logging.INFO
+    finally:
+        root.handlers = before
+
+
+def test_workers_inherit_logging_config(tmp_path):
+    """Worker-side integration: a task reports its root logger state —
+    level and formatter class must match the driver's config."""
+    import subprocess
+    import sys
+    script = tmp_path / "drv.py"
+    script.write_text("""
+import logging
+import ray_tpu
+
+ray_tpu.init(num_cpus=1, logging_config=ray_tpu.LoggingConfig(
+    encoding="JSON", log_level="DEBUG"))
+
+@ray_tpu.remote
+def probe():
+    root = logging.getLogger()
+    ours = [h for h in root.handlers
+            if getattr(h, "_ray_tpu_logging", False)]
+    return (root.getEffectiveLevel(),
+            type(ours[0].formatter).__name__ if ours else None)
+
+level, fmt = ray_tpu.get(probe.remote())
+assert level == logging.DEBUG, level
+assert fmt == "JsonFormatter", fmt
+ray_tpu.shutdown()
+print("LOGCFG-OK")
+""")
+    env = {"RAY_TPU_NUM_CHIPS": "0", "PYTHONPATH":
+           __import__("os").path.dirname(__import__("os").path.dirname(
+               __import__("os").path.abspath(__file__)))}
+    import os as _os
+    full = dict(_os.environ)
+    full.update(env)
+    out = subprocess.run([sys.executable, str(script)], env=full,
+                         capture_output=True, text=True, timeout=120)
+    assert "LOGCFG-OK" in out.stdout, out.stderr[-2000:]
+
+
+def test_stale_config_not_inherited_by_next_session(tmp_path):
+    """init(logging_config)->shutdown->init() must not leak the prior
+    session's published config into the new session's workers (r5
+    review: the env var survived shutdown)."""
+    import os
+    import subprocess
+    import sys
+    script = tmp_path / "drv2.py"
+    script.write_text("""
+import logging
+import ray_tpu
+
+ray_tpu.init(num_cpus=1, logging_config=ray_tpu.LoggingConfig(
+    encoding="JSON", log_level="DEBUG"))
+ray_tpu.shutdown()
+ray_tpu.init(num_cpus=1)   # NO logging_config: nothing may leak
+
+@ray_tpu.remote
+def probe():
+    root = logging.getLogger()
+    return [h for h in root.handlers
+            if getattr(h, "_ray_tpu_logging", False)] == []
+
+assert ray_tpu.get(probe.remote()) is True
+ray_tpu.shutdown()
+print("NO-LEAK-OK")
+""")
+    full = dict(os.environ)
+    full["RAY_TPU_NUM_CHIPS"] = "0"
+    full["PYTHONPATH"] = os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))
+    out = subprocess.run([sys.executable, str(script)], env=full,
+                         capture_output=True, text=True, timeout=180)
+    assert "NO-LEAK-OK" in out.stdout, out.stderr[-2000:]

@@ -394,3 +394,32 @@ def test_llm_chunked_prefill_keeps_decode_flowing():
     # 1-core box: each tick = one [B,1] forward, each chunk = one [1,32])
     produced_during_prefill = (before[-1] if before else 0) - mark
     assert produced_during_prefill >= 5, (mark, before[-12:], n_total)
+
+
+def test_replica_context_and_app_handle(serve_session):
+    """get_replica_context inside a replica + get_app_handle routing to
+    the app's ingress (ref: serve.get_replica_context/get_app_handle)."""
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    class WhoAmI:
+        def __init__(self):
+            # callable in __init__ already (context set before user ctor)
+            self.ctx = serve.get_replica_context()
+
+        def __call__(self):
+            ctx = serve.get_replica_context()
+            return (ctx.app_name, ctx.deployment, ctx.replica_tag,
+                    self.ctx.replica_tag)
+
+    serve.run(WhoAmI.bind(), name="whoami")
+    h = serve.get_app_handle("whoami")
+    app, dep, tag, ctor_tag = h.remote().result()
+    assert app == "whoami" and dep == "WhoAmI"
+    assert tag.startswith("WhoAmI#") and ctor_tag == tag
+    with pytest.raises(ValueError, match="no running serve application"):
+        serve.get_app_handle("nope")
+    with pytest.raises(RuntimeError, match="replica"):
+        serve.get_replica_context()   # driver side: not in a replica
+    serve.delete("whoami")

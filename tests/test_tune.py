@@ -289,3 +289,24 @@ def test_tuner_restore_resumes_sweep(tmp_path):
         assert by_id[tid].metrics_history == results, tid
     assert not grid.errors
     assert grid.get_best_result().config["x"] == 0
+
+
+def test_with_parameters_injects_object_store_refs(ray_session):
+    """with_parameters (ref: tune/trainable/util.py): large constants ride
+    the object store once and reach every trial as kwargs."""
+    import numpy as np
+
+    from ray_tpu import tune
+
+    big = np.arange(1000)
+
+    def trainable(config, data=None):
+        tune.report({"loss": float(config["x"] + data.sum())})
+
+    wrapped = tune.with_parameters(trainable, data=big)
+    results = tune.Tuner(
+        wrapped, param_space={"x": tune.choice([0, 1])},
+        tune_config=tune.TuneConfig(num_samples=2)).fit()
+    assert len(results) == 2
+    want = big.sum()
+    assert all(r.metrics["loss"] in (want, want + 1) for r in results)
