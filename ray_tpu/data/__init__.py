@@ -8,8 +8,11 @@ from .dataset import Dataset, GroupedData, from_blocks
 from .datasource import (from_arrow, from_items, from_numpy, from_pandas,
                          range, read_binary_files, read_csv, read_json,
                          read_parquet, read_text)
-from .preprocessors import (BatchMapper, Chain, Concatenator, LabelEncoder,
-                            MinMaxScaler, Preprocessor, StandardScaler)
+from .preprocessors import (BatchMapper, Chain, Concatenator,
+                            FeatureHasher, KBinsDiscretizer, LabelEncoder,
+                            MinMaxScaler, Normalizer, OneHotEncoder,
+                            Preprocessor, SimpleImputer, StandardScaler)
+from .expressions import col, lit
 from .random_access import RandomAccessDataset
 from .readers import (read_images, read_tfrecords, read_webdataset,
                       write_tfrecords)
@@ -21,5 +24,7 @@ __all__ = [
     "read_csv", "read_images", "read_json", "read_text", "read_binary_files",
     "read_tfrecords", "read_webdataset", "write_tfrecords", "Preprocessor",
     "BatchMapper", "StandardScaler", "MinMaxScaler", "LabelEncoder",
-    "Concatenator", "Chain", "RandomAccessDataset",
+    "Concatenator", "Chain", "RandomAccessDataset", "col", "lit",
+    "SimpleImputer", "Normalizer", "KBinsDiscretizer", "OneHotEncoder",
+    "FeatureHasher",
 ]

@@ -49,7 +49,19 @@ class Dataset:
             return B.block_from_rows(rows)
         return self._block_op("flat_map", _fm)
 
-    def filter(self, fn: Callable[[Dict], bool]) -> "Dataset":
+    def filter(self, fn) -> "Dataset":
+        """Keep rows where `fn(row)` is truthy, or — VECTORIZED — where a
+        boolean expression holds: `ds.filter(col("x") > 3)` (ref:
+        dataset.py filter(expr=...))."""
+        from .expressions import Expr
+        if isinstance(fn, Expr):
+            expr = fn
+
+            def _fe(block):
+                mask = np.asarray(expr.eval(block.to_pandas()), bool)
+                return block.filter(pa.array(mask))
+            return self._block_op("filter_expr", _fe)
+
         def _fl(block):
             keep = [i for i, r in enumerate(B.block_to_rows(block)) if fn(r)]
             return block.take(keep) if keep else block.slice(0, 0)
@@ -63,9 +75,13 @@ class Dataset:
         return self._block_op("add_column", _ac)
 
     def with_column(self, name: str, fn) -> "Dataset":
-        """Derive one column from the batch (ref: python/ray/data/dataset.py
-        with_column — expression-based there; a callable over the pandas
-        batch here, same contract as add_column)."""
+        """Derive one column from an expression — `ds.with_column("z",
+        col("x") + 2 * col("y"))` — or a callable over the pandas batch
+        (ref: python/ray/data/dataset.py with_column + expressions.py)."""
+        from .expressions import Expr
+        if isinstance(fn, Expr):
+            expr = fn
+            return self.add_column(name, lambda batch: expr.eval(batch))
         return self.add_column(name, fn)
 
     def drop_columns(self, cols: List[str]) -> "Dataset":

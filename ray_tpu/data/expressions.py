@@ -1,0 +1,237 @@
+"""Column expressions (reference: python/ray/data/expressions.py —
+col()/lit() operator trees consumed by with_column/filter).
+
+Same user surface as the reference's alpha expressions API; the evaluator
+is deliberately simpler — expressions evaluate VECTORIZED against a
+pandas batch (numpy broadcasting does the work), instead of compiling to
+pyarrow compute expressions through a visitor stack. That keeps one
+execution path for both arithmetic and comparison/boolean trees, and any
+numpy ufunc semantics (NaN propagation, int/float promotion) apply
+unchanged.
+"""
+
+import dataclasses
+import operator
+from typing import Any, Callable
+
+__all__ = ["Expr", "ColumnExpr", "LiteralExpr", "BinaryExpr", "UnaryExpr",
+           "AliasExpr", "col", "lit"]
+
+
+def _wrap(value) -> "Expr":
+    return value if isinstance(value, Expr) else LiteralExpr(value)
+
+
+class Expr:
+    """A node in an expression tree; build with col()/lit() and Python
+    operators, evaluate with .eval(batch)."""
+
+    # -- construction via operators -----------------------------------------
+    def _bin(self, other, op, sym, reflected=False):
+        left, right = (_wrap(other), self) if reflected else (self, _wrap(other))
+        return BinaryExpr(op, sym, left, right)
+
+    def __add__(self, o):
+        return self._bin(o, operator.add, "+")
+
+    def __radd__(self, o):
+        return self._bin(o, operator.add, "+", reflected=True)
+
+    def __sub__(self, o):
+        return self._bin(o, operator.sub, "-")
+
+    def __rsub__(self, o):
+        return self._bin(o, operator.sub, "-", reflected=True)
+
+    def __mul__(self, o):
+        return self._bin(o, operator.mul, "*")
+
+    def __rmul__(self, o):
+        return self._bin(o, operator.mul, "*", reflected=True)
+
+    def __truediv__(self, o):
+        return self._bin(o, operator.truediv, "/")
+
+    def __rtruediv__(self, o):
+        return self._bin(o, operator.truediv, "/", reflected=True)
+
+    def __floordiv__(self, o):
+        return self._bin(o, operator.floordiv, "//")
+
+    def __rfloordiv__(self, o):
+        return self._bin(o, operator.floordiv, "//", reflected=True)
+
+    def __mod__(self, o):
+        return self._bin(o, operator.mod, "%")
+
+    def __rmod__(self, o):
+        return self._bin(o, operator.mod, "%", reflected=True)
+
+    def __pow__(self, o):
+        return self._bin(o, operator.pow, "**")
+
+    def __rpow__(self, o):
+        return self._bin(o, operator.pow, "**", reflected=True)
+
+    def __gt__(self, o):
+        return self._bin(o, operator.gt, ">")
+
+    def __ge__(self, o):
+        return self._bin(o, operator.ge, ">=")
+
+    def __lt__(self, o):
+        return self._bin(o, operator.lt, "<")
+
+    def __le__(self, o):
+        return self._bin(o, operator.le, "<=")
+
+    def __eq__(self, o):  # noqa: PYI032 - expression building, not identity
+        return self._bin(o, operator.eq, "==")
+
+    def __ne__(self, o):
+        return self._bin(o, operator.ne, "!=")
+
+    __hash__ = None  # expression trees are not hashable (== builds a node)
+
+    def __and__(self, o):
+        return self._bin(o, operator.and_, "&")
+
+    def __rand__(self, o):
+        return self._bin(o, operator.and_, "&", reflected=True)
+
+    def __or__(self, o):
+        return self._bin(o, operator.or_, "|")
+
+    def __ror__(self, o):
+        return self._bin(o, operator.or_, "|", reflected=True)
+
+    def __bool__(self):
+        # `expr1 and expr2` would silently DROP expr1 (Python evaluates
+        # the left's truthiness and returns the right); same trap numpy
+        # arrays guard against. Force the vectorized operators.
+        raise TypeError(
+            "an Expr has no truth value: use & | ~ instead of and/or/not")
+
+    def __invert__(self):
+        return UnaryExpr(operator.invert, "~", self)
+
+    def __neg__(self):
+        return UnaryExpr(operator.neg, "-", self)
+
+    def alias(self, name: str) -> "AliasExpr":
+        return AliasExpr(self, name)
+
+    # -- interface -----------------------------------------------------------
+    @property
+    def name(self):
+        return None
+
+    def eval(self, batch):
+        """Evaluate against a pandas DataFrame batch → Series/array."""
+        raise NotImplementedError
+
+    def structurally_equals(self, other: Any) -> bool:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(eq=False, repr=False)
+class ColumnExpr(Expr):
+    _name: str
+
+    @property
+    def name(self):
+        return self._name
+
+    def eval(self, batch):
+        if self._name not in batch.columns:
+            raise KeyError(
+                f"expression references column {self._name!r} but the batch "
+                f"has {list(batch.columns)}")
+        return batch[self._name]
+
+    def structurally_equals(self, other):
+        return isinstance(other, ColumnExpr) and other._name == self._name
+
+    def __repr__(self):
+        return f"col({self._name!r})"
+
+
+@dataclasses.dataclass(eq=False, repr=False)
+class LiteralExpr(Expr):
+    value: Any
+
+    def eval(self, batch):
+        return self.value
+
+    def structurally_equals(self, other):
+        return (isinstance(other, LiteralExpr) and other.value == self.value
+                and type(other.value) is type(self.value))
+
+    def __repr__(self):
+        return f"lit({self.value!r})"
+
+
+@dataclasses.dataclass(eq=False, repr=False)
+class BinaryExpr(Expr):
+    op: Callable
+    sym: str
+    left: Expr
+    right: Expr
+
+    def eval(self, batch):
+        return self.op(self.left.eval(batch), self.right.eval(batch))
+
+    def structurally_equals(self, other):
+        return (isinstance(other, BinaryExpr) and other.op is self.op
+                and self.left.structurally_equals(other.left)
+                and self.right.structurally_equals(other.right))
+
+    def __repr__(self):
+        return f"({self.left!r} {self.sym} {self.right!r})"
+
+
+@dataclasses.dataclass(eq=False, repr=False)
+class UnaryExpr(Expr):
+    op: Callable
+    sym: str
+    operand: Expr
+
+    def eval(self, batch):
+        return self.op(self.operand.eval(batch))
+
+    def structurally_equals(self, other):
+        return (isinstance(other, UnaryExpr) and other.op is self.op
+                and self.operand.structurally_equals(other.operand))
+
+    def __repr__(self):
+        return f"{self.sym}{self.operand!r}"
+
+
+@dataclasses.dataclass(eq=False, repr=False)
+class AliasExpr(Expr):
+    inner: Expr
+    _name: str
+
+    @property
+    def name(self):
+        return self._name
+
+    def eval(self, batch):
+        return self.inner.eval(batch)
+
+    def structurally_equals(self, other):
+        return (isinstance(other, AliasExpr) and other._name == self._name
+                and self.inner.structurally_equals(other.inner))
+
+    def __repr__(self):
+        return f"{self.inner!r}.alias({self._name!r})"
+
+
+def col(name: str) -> ColumnExpr:
+    """Reference a column (ref: expressions.py:1623)."""
+    return ColumnExpr(name)
+
+
+def lit(value: Any) -> LiteralExpr:
+    """Embed a constant (ref: expressions.py:1651)."""
+    return LiteralExpr(value)

@@ -60,14 +60,7 @@ class StandardScaler(Preprocessor):
         self.stats_: Dict[str, tuple] = {}
 
     def _fit(self, ds: Dataset) -> None:
-        acc = {c: [0.0, 0.0, 0] for c in self.columns}  # sum, sumsq, n
-        for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
-            for c in self.columns:
-                v = batch[c].astype(np.float64)
-                acc[c][0] += v.sum()
-                acc[c][1] += np.square(v).sum()
-                acc[c][2] += v.size
-        for c, (s, ss, n) in acc.items():
+        for c, (s, ss, n) in _fit_moments(ds, self.columns).items():
             mean = s / max(n, 1)
             var = max(ss / max(n, 1) - mean * mean, 0.0)
             self.stats_[c] = (mean, float(np.sqrt(var)) or 1.0)
@@ -85,13 +78,7 @@ class MinMaxScaler(Preprocessor):
         self.stats_: Dict[str, tuple] = {}
 
     def _fit(self, ds: Dataset) -> None:
-        lo = {c: np.inf for c in self.columns}
-        hi = {c: -np.inf for c in self.columns}
-        for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
-            for c in self.columns:
-                lo[c] = min(lo[c], float(batch[c].min()))
-                hi[c] = max(hi[c], float(batch[c].max()))
-        self.stats_ = {c: (lo[c], hi[c]) for c in self.columns}
+        self.stats_ = _fit_minmax(ds, self.columns)
 
     def _transform_numpy(self, batch):
         out = dict(batch)
@@ -158,3 +145,234 @@ class Chain(Preprocessor):
         for p in self.preprocessors:
             ds = p.transform(ds)
         return ds
+
+
+def _is_missing(v) -> bool:
+    """Missing = float NaN OR None (arrow nulls convert to None for
+    string/object columns, NaN for float ones)."""
+    return v is None or (isinstance(v, float) and np.isnan(v))
+
+
+def _fit_minmax(ds: Dataset, columns: List[str]) -> Dict[str, tuple]:
+    """One streaming pass → {col: (min, max)} (shared by MinMaxScaler and
+    KBinsDiscretizer's uniform strategy)."""
+    lo = {c: np.inf for c in columns}
+    hi = {c: -np.inf for c in columns}
+    for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
+        for c in columns:
+            lo[c] = min(lo[c], float(batch[c].min()))
+            hi[c] = max(hi[c], float(batch[c].max()))
+    return {c: (lo[c], hi[c]) for c in columns}
+
+
+def _fit_moments(ds: Dataset, columns: List[str],
+                 skip_nan: bool = False) -> Dict[str, tuple]:
+    """One streaming pass → {col: (sum, sumsq, n)} (shared by
+    StandardScaler and SimpleImputer's mean strategy)."""
+    acc = {c: [0.0, 0.0, 0] for c in columns}
+    for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
+        for c in columns:
+            v = np.asarray(batch[c], np.float64)
+            if skip_nan:
+                v = v[~np.isnan(v)]
+            acc[c][0] += v.sum()
+            acc[c][1] += np.square(v).sum()
+            acc[c][2] += v.size
+    return {c: tuple(a) for c, a in acc.items()}
+
+
+class SimpleImputer(Preprocessor):
+    """Fill missing values (NaN) per column (ref: preprocessors/imputer.py
+    SimpleImputer; strategies mean/most_frequent/constant)."""
+
+    def __init__(self, columns: List[str], strategy: str = "mean",
+                 fill_value=None):
+        if strategy not in ("mean", "most_frequent", "constant"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        if strategy == "constant" and fill_value is None:
+            raise ValueError("strategy='constant' needs fill_value")
+        self.columns = columns
+        self.strategy = strategy
+        self.fill_value = fill_value
+        self.stats_: Dict[str, object] = {}
+
+    def _needs_fit(self) -> bool:
+        return self.strategy != "constant"
+
+    def _fit(self, ds: Dataset) -> None:
+        if self.strategy == "mean":
+            self.stats_ = {c: s / max(n, 1) for c, (s, _ss, n) in
+                           _fit_moments(ds, self.columns,
+                                        skip_nan=True).items()}
+        else:  # most_frequent
+            from collections import Counter
+            counts = {c: Counter() for c in self.columns}
+            for batch in ds.iter_batches(batch_format="numpy",
+                                         prefetch_batches=0):
+                for c in self.columns:
+                    vals = [v for v in np.asarray(batch[c]).tolist()
+                            if not _is_missing(v)]
+                    counts[c].update(vals)
+            for c in self.columns:
+                if not counts[c]:
+                    raise ValueError(
+                        f"SimpleImputer(most_frequent): column {c!r} has "
+                        f"no non-missing values to impute from")
+            self.stats_ = {c: counts[c].most_common(1)[0][0]
+                           for c in self.columns}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            fill = (self.fill_value if self.strategy == "constant"
+                    else self.stats_[c])
+            v = np.asarray(batch[c])
+            if np.issubdtype(v.dtype, np.floating):
+                out[c] = np.where(np.isnan(v), fill, v)
+            else:  # object/string columns: missing is None (arrow null)
+                out[c] = np.array([fill if _is_missing(x) else x
+                                   for x in v.tolist()])
+        return out
+
+
+class Normalizer(Preprocessor):
+    """Row-wise re-norm across feature columns (ref: preprocessors/
+    normalizer.py; norms l1/l2/max)."""
+
+    _NORMS = {"l1": lambda m: np.abs(m).sum(1),
+              "l2": lambda m: np.sqrt(np.square(m).sum(1)),
+              "max": lambda m: np.abs(m).max(1)}
+
+    def __init__(self, columns: List[str], norm: str = "l2"):
+        if norm not in self._NORMS:
+            raise ValueError(f"unknown norm {norm!r}")
+        self.columns = columns
+        self.norm = norm
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        mat = np.stack([np.asarray(batch[c], np.float64)
+                        for c in self.columns], 1)
+        denom = np.maximum(self._NORMS[self.norm](mat), 1e-12)
+        for i, c in enumerate(self.columns):
+            out[c] = mat[:, i] / denom
+        return out
+
+
+class KBinsDiscretizer(Preprocessor):
+    """Bin continuous columns to integer ordinals (ref: preprocessors/
+    discretizer.py UniformKBinsDiscretizer); uniform or quantile edges."""
+
+    def __init__(self, columns: List[str], bins: int = 5,
+                 strategy: str = "uniform"):
+        if strategy not in ("uniform", "quantile"):
+            raise ValueError(f"unknown strategy {strategy!r}")
+        self.columns = columns
+        self.bins = bins
+        self.strategy = strategy
+        self.edges_: Dict[str, np.ndarray] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        # single pass for uniform (min/max); quantile needs the values —
+        # sample-bounded so fit never concats the world
+        if self.strategy == "uniform":
+            mm = _fit_minmax(ds, self.columns)
+            self.edges_ = {c: np.linspace(lo, hi, self.bins + 1)[1:-1]
+                           for c, (lo, hi) in mm.items()}
+        else:
+            cap = 100_000
+            sample = {c: [] for c in self.columns}
+            seen = 0
+            for batch in ds.iter_batches(batch_format="numpy",
+                                         prefetch_batches=0):
+                for c in self.columns:
+                    sample[c].append(np.asarray(batch[c], np.float64))
+                seen += len(next(iter(batch.values())))
+                if seen >= cap:
+                    break
+            qs = np.linspace(0, 1, self.bins + 1)[1:-1]
+            self.edges_ = {c: np.quantile(np.concatenate(sample[c]), qs)
+                           for c in self.columns}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            out[c] = np.searchsorted(self.edges_[c],
+                                     np.asarray(batch[c], np.float64),
+                                     side="right").astype(np.int64)
+        return out
+
+
+class OneHotEncoder(Preprocessor):
+    """Categorical column → dense one-hot matrix column `<col>_onehot`
+    (ref: preprocessors/encoder.py OneHotEncoder). Unseen categories at
+    transform time encode as all-zeros rather than raising."""
+
+    def __init__(self, columns: List[str]):
+        self.columns = columns
+        self.categories_: Dict[str, List] = {}
+
+    def _fit(self, ds: Dataset) -> None:
+        seen = {c: set() for c in self.columns}
+        for batch in ds.iter_batches(batch_format="numpy",
+                                     prefetch_batches=0):
+            for c in self.columns:
+                # missing (None / NaN) is NOT a category: it encodes as
+                # the all-zeros row, same as an unseen value (and NaN !=
+                # NaN would otherwise accrete one "category" per batch)
+                seen[c].update(v for v in np.asarray(batch[c]).tolist()
+                               if not _is_missing(v))
+        self.categories_ = {c: sorted(v) for c, v in seen.items()}
+
+    def _transform_numpy(self, batch):
+        out = dict(batch)
+        for c in self.columns:
+            cats = self.categories_[c]
+            lookup = {v: i for i, v in enumerate(cats)}
+            vals = np.asarray(batch[c]).tolist()
+            mat = np.zeros((len(vals), len(cats)), np.float32)
+            for r, v in enumerate(vals):
+                i = lookup.get(v)
+                if i is not None:
+                    mat[r, i] = 1.0
+            del out[c]
+            out[f"{c}_onehot"] = mat
+        return out
+
+
+class FeatureHasher(Preprocessor):
+    """Hash token lists / strings into a fixed-width count vector (ref:
+    preprocessors/hasher.py FeatureHasher) — unbounded vocab, bounded
+    feature width, no fit pass."""
+
+    def __init__(self, columns: List[str], num_features: int = 256,
+                 output_column_name: str = "hashed_features"):
+        self.columns = columns
+        self.num_features = num_features
+        self.output_column_name = output_column_name
+
+    def _needs_fit(self) -> bool:
+        return False
+
+    def _fit(self, ds):
+        pass
+
+    def _transform_numpy(self, batch):
+        import zlib
+        out = {k: v for k, v in batch.items() if k not in self.columns}
+        n = len(next(iter(batch.values())))
+        mat = np.zeros((n, self.num_features), np.float32)
+        for c in self.columns:
+            for r, v in enumerate(np.asarray(batch[c]).tolist()):
+                tokens = v if isinstance(v, (list, np.ndarray)) else [v]
+                for t in tokens:
+                    h = zlib.crc32(str(t).encode()) % self.num_features
+                    mat[r, h] += 1.0
+        out[self.output_column_name] = mat
+        return out

@@ -1,0 +1,69 @@
+"""Expressions API (ref: python/ray/data/expressions.py col/lit trees
+consumed by with_column/filter)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ray_tpu import data as rd
+from ray_tpu.data import col, lit
+
+
+def test_arithmetic_matches_pandas():
+    df = pd.DataFrame({"x": [1.0, 2.0, 3.0], "y": [10.0, 20.0, 30.0]})
+    ds = rd.from_pandas(df)
+    out = ds.with_column("z", (col("x") + lit(5)) * col("y")).to_pandas()
+    pd.testing.assert_series_equal(out["z"], ((df.x + 5) * df.y),
+                                   check_names=False)
+    out2 = ds.with_column("w", 2 * col("x") - col("y") / 10).to_pandas()
+    pd.testing.assert_series_equal(out2["w"], 2 * df.x - df.y / 10,
+                                   check_names=False)
+
+
+def test_filter_expression_vectorized():
+    ds = rd.range(100)
+    got = sorted(r["id"] for r in
+                 ds.filter((col("id") > 10) & (col("id") % 7 == 0)).take_all())
+    assert got == [i for i in range(100) if i > 10 and i % 7 == 0]
+    neg = ds.filter(~(col("id") < 95)).take_all()
+    assert sorted(r["id"] for r in neg) == [95, 96, 97, 98, 99]
+
+
+def test_alias_and_repr_and_structural_equality():
+    e = (col("x") + lit(5)) * col("y")
+    assert repr(e) == "((col('x') + lit(5)) * col('y'))"
+    assert e.structurally_equals((col("x") + lit(5)) * col("y"))
+    assert not e.structurally_equals((col("x") - lit(5)) * col("y"))
+    a = e.alias("z")
+    assert a.name == "z"
+    df = pd.DataFrame({"x": [1.0], "y": [2.0]})
+    assert float(a.eval(df).iloc[0]) == 12.0
+
+
+def test_missing_column_raises_with_names():
+    ds = rd.from_pandas(pd.DataFrame({"x": [1]}))
+    with pytest.raises(Exception, match="nope"):
+        ds.with_column("z", col("nope") + 1).to_pandas()
+
+
+def test_python_bool_ops_raise_not_silently_drop():
+    """`and`/`or`/`not` on expressions would silently drop a side (Python
+    truthiness); they must raise like numpy arrays do (r5 review repro:
+    (a) and (b) returned only b)."""
+    with pytest.raises(TypeError, match="truth value"):
+        bool(col("x") > 1)
+    with pytest.raises(TypeError, match="truth value"):
+        (col("id") > 5) and (col("id") < 3)  # noqa: B015
+
+
+def test_reflected_operators_complete():
+    df = pd.DataFrame({"x": [2.0, 3.0]})
+    ds = rd.from_pandas(df)
+    assert [r["z"] for r in
+            ds.with_column("z", 2 ** col("x")).take_all()] == [4.0, 8.0]
+    assert [r["z"] for r in
+            ds.with_column("z", 10 % col("x")).take_all()] == [0.0, 1.0]
+    assert [r["z"] for r in
+            ds.with_column("z", 7 // col("x")).take_all()] == [3.0, 2.0]
+    got = rd.range(6).filter(True & (col("id") > 3)).take_all()
+    assert sorted(r["id"] for r in got) == [4, 5]

@@ -1,0 +1,83 @@
+"""New preprocessors (ref: python/ray/data/preprocessors/{imputer,
+normalizer,discretizer,encoder,hasher}.py)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from ray_tpu import data as rd
+from ray_tpu.data import (FeatureHasher, KBinsDiscretizer, Normalizer,
+                          OneHotEncoder, SimpleImputer)
+
+
+def test_simple_imputer_mean_and_constant():
+    ds = rd.from_pandas(pd.DataFrame({"x": [1.0, np.nan, 3.0]}))
+    out = SimpleImputer(["x"], strategy="mean").fit_transform(ds).take_all()
+    assert [r["x"] for r in out] == [1.0, 2.0, 3.0]
+    out = SimpleImputer(["x"], strategy="constant",
+                        fill_value=-1.0).transform(ds).take_all()
+    assert [r["x"] for r in out] == [1.0, -1.0, 3.0]
+    with pytest.raises(ValueError, match="fill_value"):
+        SimpleImputer(["x"], strategy="constant")
+
+
+def test_simple_imputer_most_frequent():
+    ds = rd.from_pandas(pd.DataFrame({"c": ["a", "b", "a", None]}))
+    out = SimpleImputer(["c"], strategy="most_frequent") \
+        .fit_transform(ds).take_all()
+    assert [r["c"] for r in out] == ["a", "b", "a", "a"]
+
+
+def test_normalizer_matches_sklearn_def():
+    df = pd.DataFrame({"a": [3.0, 0.0], "b": [4.0, 0.0]})
+    ds = rd.from_pandas(df)
+    out = Normalizer(["a", "b"], norm="l2").transform(ds).take_all()
+    assert out[0]["a"] == pytest.approx(0.6)
+    assert out[0]["b"] == pytest.approx(0.8)
+    assert out[1]["a"] == 0.0   # zero row stays zero (no div-by-zero)
+    l1 = Normalizer(["a", "b"], norm="l1").transform(ds).take_all()
+    assert l1[0]["a"] + l1[0]["b"] == pytest.approx(1.0)
+
+
+def test_kbins_uniform_and_quantile():
+    vals = list(np.linspace(0, 10, 101))
+    ds = rd.from_items([{"x": float(v)} for v in vals])
+    uni = KBinsDiscretizer(["x"], bins=5).fit_transform(ds).take_all()
+    got = [r["x"] for r in uni]
+    assert min(got) == 0 and max(got) == 4
+    assert got == sorted(got)          # monotone in the input
+    q = KBinsDiscretizer(["x"], bins=4,
+                         strategy="quantile").fit_transform(ds).take_all()
+    counts = np.bincount([r["x"] for r in q])
+    assert counts.min() >= 20          # near-equal mass per quantile bin
+
+
+def test_one_hot_encoder_and_unseen():
+    ds = rd.from_items([{"c": "a"}, {"c": "b"}, {"c": "a"}])
+    enc = OneHotEncoder(["c"]).fit(ds)
+    out = enc.transform(ds).take_all()
+    assert list(out[0]["c_onehot"]) == [1.0, 0.0]
+    assert list(out[1]["c_onehot"]) == [0.0, 1.0]
+    unseen = enc.transform(rd.from_items([{"c": "zzz"}])).take_all()
+    assert list(unseen[0]["c_onehot"]) == [0.0, 0.0]
+
+
+def test_feature_hasher_deterministic_counts():
+    ds = rd.from_items([{"toks": ["a", "b", "a"]}, {"toks": ["c"]}])
+    out = FeatureHasher(["toks"], num_features=16).transform(ds).take_all()
+    assert out[0]["hashed_features"].sum() == 3.0   # counts, not binary
+    assert out[1]["hashed_features"].sum() == 1.0
+    again = FeatureHasher(["toks"], num_features=16).transform(ds).take_all()
+    assert np.array_equal(out[0]["hashed_features"],
+                          again[0]["hashed_features"])
+
+
+def test_one_hot_ignores_missing_and_imputer_all_missing_raises():
+    ds = rd.from_pandas(pd.DataFrame({"c": ["a", None, "b"]}))
+    enc = OneHotEncoder(["c"]).fit(ds)
+    assert enc.categories_["c"] == ["a", "b"]   # None is not a category
+    out = enc.transform(ds).take_all()
+    assert list(out[1]["c_onehot"]) == [0.0, 0.0]
+    empty = rd.from_pandas(pd.DataFrame({"c": [None, None]}))
+    with pytest.raises(ValueError, match="no non-missing"):
+        SimpleImputer(["c"], strategy="most_frequent").fit(empty)
