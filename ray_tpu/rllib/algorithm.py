@@ -195,8 +195,15 @@ class Algorithm:
     # -- runner fleet --------------------------------------------------------
     def _make_runner_kwargs(self) -> Dict[str, Any]:
         cfg = self.config
+        env = cfg.env
+        if isinstance(env, str):
+            # resolve registered names HERE (driver), where register_env
+            # ran: remote runner actors are fresh processes whose own
+            # registry is empty — the callable must ship by value
+            from .env_runner import resolve_env_creator
+            env = resolve_env_creator(env, cfg.env_config)
         return dict(
-            env_creator=cfg.env,
+            env_creator=env,
             num_envs=cfg.num_envs_per_env_runner,
             rollout_len=cfg.rollout_fragment_length,
             explore=cfg.explore,

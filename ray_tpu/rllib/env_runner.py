@@ -28,16 +28,31 @@ def _make_vector_env(env_creator, num_envs: int):
         return gym.vector.SyncVectorEnv([env_creator for _ in range(num_envs)])
 
 
+def resolve_env_creator(name: str, env_config: Optional[dict] = None):
+    """String env → callable, DRIVER-side: tune.register_env names win
+    over gym ids (ref: rllib resolves through tune/registry.py before
+    gym.make). Must run where the registration happened — the returned
+    CALLABLE then pickles by value into remote runner actors, whose own
+    process-local registry is empty. Each invocation hands the creator a
+    fresh dict copy (vector envs call it N times; a creator that pops
+    keys must not corrupt its siblings' config)."""
+    from ray_tpu.tune.registry import get_env_creator
+    registered = get_env_creator(name)
+    if registered is not None:
+        return lambda: registered(dict(env_config or {}))
+    import gymnasium as gym
+    return functools.partial(gym.make, name, **(env_config or {}))
+
+
 class EnvRunner:
     def __init__(self, env_creator: Union[str, Callable], *,
                  num_envs: int = 1, rollout_len: int = 200,
                  module_spec: Optional[ModuleSpec] = None,
                  module=None, explore: bool = True, seed: int = 0,
-                 gamma: float = 0.99, record_next_obs: bool = False):
+                 gamma: float = 0.99, record_next_obs: bool = False,
+                 env_config: Optional[dict] = None):
         if isinstance(env_creator, str):
-            env_id = env_creator
-            import gymnasium as gym
-            env_creator = functools.partial(gym.make, env_id)
+            env_creator = resolve_env_creator(env_creator, env_config)
         self.envs = _make_vector_env(env_creator, num_envs)
         self.num_envs = num_envs
         self.rollout_len = rollout_len

@@ -18,12 +18,17 @@ from .search_space import (choice, grid_search, lograndint, loguniform,
                            quniform, randint, randn, sample_from, uniform)
 from .stopper import (CombinedStopper, FunctionStopper,
                       MaximumIterationStopper, Stopper, TrialPlateauStopper)
+from .experiment import (ExperimentAnalysis, Trainable,
+                         create_scheduler, create_searcher, run)
+from .registry import register_env, register_trainable
 from .tuner import (ResultGrid, TrialResult, TuneConfig, TuneError,
                     Tuner, with_parameters, with_resources)
 
 __all__ = [
     "Tuner", "TuneConfig", "TuneError", "ResultGrid", "TrialResult",
     "with_resources", "with_parameters", "Checkpoint", "CheckpointConfig",
+    "run", "Trainable", "ExperimentAnalysis", "register_env",
+    "register_trainable", "create_scheduler", "create_searcher",
     "FailureConfig", "Result", "RunConfig",
     "report", "get_checkpoint", "get_context",
     "choice", "uniform", "quniform", "loguniform", "qloguniform",

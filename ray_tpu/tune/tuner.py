@@ -442,8 +442,17 @@ class Tuner:
         if isinstance(stop, Stopper):
             return stop
         if callable(stop):
+            import inspect
+
             from .stopper import FunctionStopper
-            return FunctionStopper(lambda tid, r: stop(r))
+            # both stop signatures exist in the wild: the reference's
+            # stop(trial_id, result) and the bare stop(result)
+            try:
+                two_arg = len(inspect.signature(stop).parameters) >= 2
+            except (TypeError, ValueError):
+                two_arg = False
+            return FunctionStopper(stop if two_arg
+                                   else (lambda tid, r: stop(r)))
         if isinstance(stop, dict):
             crit = dict(stop)
 
