@@ -30,13 +30,39 @@ class Dataset:
         return self._block_op("map", _map)
 
     def map_batches(self, fn, *, batch_format: str = "numpy",
-                    batch_size: Optional[int] = None, **_compat) -> "Dataset":
+                    batch_size: Optional[int] = None,
+                    fn_constructor_args: Optional[tuple] = None,
+                    fn_constructor_kwargs: Optional[Dict] = None,
+                    **_compat) -> "Dataset":
+        """Transform batches with a function OR a callable CLASS (ref:
+        python/ray/data/dataset.py map_batches ClassUDF): a class is
+        constructed once per worker process and reused across the blocks
+        that worker transforms — expensive setup (model load) amortizes
+        the way the reference's actor-pool UDFs do."""
+        if isinstance(fn, type):
+            import hashlib
+            import uuid
+
+            import cloudpickle
+            spec = cloudpickle.dumps((fn, tuple(fn_constructor_args or ()),
+                                      dict(fn_constructor_kwargs or {})))
+            # the op id keeps instances PRIVATE to this map_batches call:
+            # a stateful UDF reused in two pipelines must not share state
+            # (the reference gives each op its own actor pool)
+            key = uuid.uuid4().hex + hashlib.sha1(spec).hexdigest()
+
+            def call(batch):
+                from ray_tpu.data.udf_cache import get_udf_instance
+                return get_udf_instance(key, spec)(batch)
+        else:
+            call = fn
+
         def _mb(block):
             outs = []
             sub_blocks = (B.split_block_rows(block, batch_size)
                           if batch_size else [block])
             for sb in sub_blocks:
-                out = fn(B.block_to_format(sb, batch_format))
+                out = call(B.block_to_format(sb, batch_format))
                 outs.append(B.block_from_format(out))
             return B.block_concat(outs)
         return self._block_op("map_batches", _mb)

@@ -1,0 +1,32 @@
+"""Per-process UDF instance cache for class-based map_batches
+(reference: ray.data map_batches(ClassUDF) runs instances in an actor
+pool so expensive __init__ — model loads — happens once per worker).
+
+Here fused block ops already fan out over the shared worker pool as
+tasks; the actor-pool semantics reduce to "construct once per worker
+process": the driver ships (class, ctor args) as pickled bytes keyed by
+their content hash, and the first block a worker processes constructs
+the instance, every later block reuses it. A worker that dies simply
+rebuilds on its replacement — no pool bookkeeping."""
+
+import collections
+
+# Bounded LRU: a finished pipeline's model instance must not pin worker
+# memory forever (the reference frees the op's actor pool at dataset
+# completion; workers here can't observe completion, so boundedness is
+# the substitute). 4 concurrent class-UDF ops per worker before the
+# least-recent gets dropped — an evicted op simply reconstructs.
+_MAX_INSTANCES = 4
+_INSTANCES: "collections.OrderedDict[str, object]" = collections.OrderedDict()
+
+
+def get_udf_instance(key: str, spec: bytes):
+    inst = _INSTANCES.get(key)
+    if inst is None:
+        import cloudpickle
+        cls, args, kwargs = cloudpickle.loads(spec)
+        inst = _INSTANCES[key] = cls(*args, **kwargs)
+    _INSTANCES.move_to_end(key)
+    while len(_INSTANCES) > _MAX_INSTANCES:
+        _INSTANCES.popitem(last=False)
+    return inst

@@ -101,7 +101,8 @@ class DQN(Algorithm):
     def setup(self, config: DQNConfig):
         from ..env_runner import EnvRunner
         # probe the spaces first: runners need the Q-module at construction
-        probe = EnvRunner(env_creator=config.env, num_envs=1, rollout_len=2)
+        probe = EnvRunner(env_creator=config.env, num_envs=1, rollout_len=2,
+                          env_config=config.env_config)
         spec = probe.get_spec()
         probe.close()
         self.module = DQNModule(spec, dueling=config.dueling)

@@ -118,7 +118,8 @@ class SAC(Algorithm):
     def setup(self, config: SACConfig):
         import gymnasium as gym
         from ..env_runner import EnvRunner
-        probe = EnvRunner(env_creator=config.env, num_envs=1, rollout_len=2)
+        probe = EnvRunner(env_creator=config.env, num_envs=1, rollout_len=2,
+                          env_config=config.env_config)
         spec = probe.get_spec()
         space = probe.envs.single_action_space
         low = float(np.min(space.low))

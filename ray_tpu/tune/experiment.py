@@ -53,16 +53,31 @@ class Trainable:
         self.cleanup()
 
 
-def _class_to_function(cls, max_iters: int) -> Callable:
+def _class_to_function(cls, max_iters: int,
+                       checkpoint_freq: int = 0) -> Callable:
     """Wrap a Trainable class into the function-trainable contract: a
     step loop reporting each result, honoring session stop requests via
-    report() raising TrainingStopped."""
+    report() raising TrainingStopped. With checkpoint_freq>0 the class's
+    save_checkpoint hook runs every N iterations (and load_checkpoint on
+    resume), so class trainables checkpoint exactly like function ones."""
     def fn(config):
-        from ray_tpu.train.session import report
+        import tempfile
+
+        from ray_tpu.train.checkpoint import Checkpoint
+        from ray_tpu.train.session import get_checkpoint, report
         t = cls(config)
+        start = get_checkpoint()
+        if start is not None:
+            t.load_checkpoint(start.path)
         try:
-            for _ in range(max_iters):
-                report(t.train())
+            for i in range(max_iters):
+                result = t.train()
+                if checkpoint_freq and (i + 1) % checkpoint_freq == 0:
+                    with tempfile.TemporaryDirectory() as d:
+                        t.save_checkpoint(d)
+                        report(result, checkpoint=Checkpoint.from_directory(d))
+                else:
+                    report(result)
         finally:
             t.stop()
     if hasattr(cls, "_tune_resources"):
@@ -106,6 +121,7 @@ def run(run_or_experiment: Union[str, Callable, type], *,
         scheduler=None, search_alg=None, name: Optional[str] = None,
         storage_path: Optional[str] = None, max_concurrent_trials: int = 4,
         resources_per_trial: Optional[Dict] = None,
+        checkpoint_freq: int = 0,
         _max_class_iters: int = 1000, **_compat) -> ExperimentAnalysis:
     """Drop-in tune.run (ref: python/ray/tune/tune.py run). Accepts a
     function trainable, a Trainable subclass, or a register_trainable'd
@@ -122,7 +138,8 @@ def run(run_or_experiment: Union[str, Callable, type], *,
         iters = _max_class_iters
         if isinstance(stop, dict) and "training_iteration" in stop:
             iters = int(stop["training_iteration"])
-        trainable = _class_to_function(trainable, iters)
+        trainable = _class_to_function(trainable, iters,
+                                       checkpoint_freq=checkpoint_freq)
     if resources_per_trial:
         # wrap, never mutate: setting the attr on a registered/shared
         # trainable would leak resources into unrelated tune.run calls

@@ -448,7 +448,14 @@ class Tuner:
             # both stop signatures exist in the wild: the reference's
             # stop(trial_id, result) and the bare stop(result)
             try:
-                two_arg = len(inspect.signature(stop).parameters) >= 2
+                required = [p for p in
+                            inspect.signature(stop).parameters.values()
+                            if p.default is inspect.Parameter.empty
+                            and p.kind in (p.POSITIONAL_ONLY,
+                                           p.POSITIONAL_OR_KEYWORD)]
+                # only REQUIRED positionals count: stop(result,
+                # verbose=False) is a one-arg stopper
+                two_arg = len(required) >= 2
             except (TypeError, ValueError):
                 two_arg = False
             return FunctionStopper(stop if two_arg

@@ -504,3 +504,40 @@ def test_sum_no_int64_wrap_within_block_and_dup_webdataset_keys(tmp_path):
                          {"__key__": "k", "a": b"2"}]).repartition(1)
     with pytest.raises(ValueError, match="duplicate"):
         dup.write_webdataset(str(tmp_path / "w"))
+
+
+def test_map_batches_class_udf_constructs_once_per_process(tmp_path,
+                                                           ray_session):
+    """Class UDFs (ref: map_batches ClassUDF actor pool): __init__ runs
+    once per worker process, not once per block."""
+    marker = str(tmp_path / "ctor_log")
+
+    class AddBias:
+        def __init__(self, bias):
+            with open(marker, "a") as f:
+                f.write(f"{__import__('os').getpid()}\n")
+            self.bias = bias
+
+        def __call__(self, batch):
+            return {"id": batch["id"] + self.bias}
+
+    ds = rd.range(40, override_num_blocks=8).map_batches(
+        AddBias, fn_constructor_args=(100,))
+    got = sorted(r["id"] for r in ds.take_all())
+    assert got == list(range(100, 140))
+    pids = open(marker).read().split()
+    # one construction per distinct process that touched blocks — never
+    # one per block (8 blocks were processed)
+    assert len(pids) == len(set(pids))
+
+
+def test_map_batches_class_udf_kwargs_inline():
+    class Scale:
+        def __init__(self, *, factor=1):
+            self.factor = factor
+
+        def __call__(self, batch):
+            return {"id": batch["id"] * self.factor}
+
+    ds = rd.range(5).map_batches(Scale, fn_constructor_kwargs={"factor": 3})
+    assert [r["id"] for r in ds.take_all()] == [0, 3, 6, 9, 12]
