@@ -39,33 +39,47 @@ class Dataset:
         constructed once per worker process and reused across the blocks
         that worker transforms — expensive setup (model load) amortizes
         the way the reference's actor-pool UDFs do."""
+        if (fn_constructor_args or fn_constructor_kwargs) \
+                and not isinstance(fn, type):
+            raise ValueError(
+                "fn_constructor_args/kwargs require a CLASS UDF; got "
+                f"{type(fn).__name__} (construct the instance yourself, "
+                f"or pass the class)")
+
+        def make_mb(call):
+            def _mb(block):
+                outs = []
+                sub_blocks = (B.split_block_rows(block, batch_size)
+                              if batch_size else [block])
+                for sb in sub_blocks:
+                    out = call(B.block_to_format(sb, batch_format))
+                    outs.append(B.block_from_format(out))
+                return B.block_concat(outs)
+            return _mb
+
         if isinstance(fn, type):
-            import hashlib
             import uuid
 
             import cloudpickle
             spec = cloudpickle.dumps((fn, tuple(fn_constructor_args or ()),
                                       dict(fn_constructor_kwargs or {})))
-            # the op id keeps instances PRIVATE to this map_batches call:
-            # a stateful UDF reused in two pipelines must not share state
-            # (the reference gives each op its own actor pool)
-            key = uuid.uuid4().hex + hashlib.sha1(spec).hexdigest()
 
-            def call(batch):
-                from ray_tpu.data.udf_cache import get_udf_instance
-                return get_udf_instance(key, spec)(batch)
-        else:
-            call = fn
+            def factory():
+                # fresh key PER PLAN EXECUTION (plan._fuse calls this):
+                # instances are private to this op AND this run — a lazy
+                # Dataset consumed twice, or two pipelines sharing the
+                # class, never see each other's UDF state (the reference
+                # builds a fresh actor pool per op per execution)
+                key = uuid.uuid4().hex
 
-        def _mb(block):
-            outs = []
-            sub_blocks = (B.split_block_rows(block, batch_size)
-                          if batch_size else [block])
-            for sb in sub_blocks:
-                out = call(B.block_to_format(sb, batch_format))
-                outs.append(B.block_from_format(out))
-            return B.block_concat(outs)
-        return self._block_op("map_batches", _mb)
+                def call(batch):
+                    from ray_tpu.data.udf_cache import get_udf_instance
+                    return get_udf_instance(key, spec)(batch)
+                return make_mb(call)
+
+            return Dataset(self._plan.with_op(BlockOp(
+                "map_batches", factory(), fn_factory=factory)))
+        return self._block_op("map_batches", make_mb(fn))
 
     def flat_map(self, fn: Callable[[Dict], List[Dict]]) -> "Dataset":
         def _fm(block):

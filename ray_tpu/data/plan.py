@@ -23,10 +23,15 @@ from .streaming import (DEFAULT_OP_BUDGET, ShuffleOp, StreamingExecutor,
 class BlockOp:
     """Per-block transform (fusable). `indexed=True` ops take
     (block, block_idx) — the executor passes the stable per-stage block
-    index so seeded randomness can vary per block (e.g. random_sample)."""
+    index so seeded randomness can vary per block (e.g. random_sample).
+    `fn_factory`, when set, is called ONCE PER PLAN EXECUTION to produce
+    a fresh fn — ops with per-execution identity (class-UDF map_batches
+    mints a new instance-cache key so a re-consumed lazy Dataset can't
+    reuse a stateful instance from the previous run)."""
     name: str
     fn: Callable[[pa.Table], pa.Table]
     indexed: bool = False
+    fn_factory: Optional[Callable[[], Callable]] = None
 
 
 @dataclass
@@ -209,7 +214,10 @@ class Plan:
 
 
 def _fuse(ops: List[BlockOp]) -> Callable[[pa.Table], pa.Table]:
-    pairs = [(o.fn, o.indexed) for o in ops]
+    # _fuse runs per plan execution (seg_stages / apply_fused), so a
+    # factory-backed op gets its fresh per-execution fn here
+    pairs = [(o.fn_factory() if o.fn_factory is not None else o.fn,
+              o.indexed) for o in ops]
 
     if any(ix for _f, ix in pairs):
         def fused(block: pa.Table, idx: int) -> pa.Table:

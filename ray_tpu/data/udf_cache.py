@@ -4,9 +4,12 @@ pool so expensive __init__ — model loads — happens once per worker).
 
 Here fused block ops already fan out over the shared worker pool as
 tasks; the actor-pool semantics reduce to "construct once per worker
-process": the driver ships (class, ctor args) as pickled bytes keyed by
-their content hash, and the first block a worker processes constructs
-the instance, every later block reuses it. A worker that dies simply
+process PER OP-EXECUTION": the driver ships (class, ctor args) as
+pickled bytes under a key minted fresh for every plan execution
+(dataset.py map_batches `factory`), so the first block a worker
+processes constructs the instance and every later block of THE SAME RUN
+reuses it — while re-consuming a lazy Dataset, or a second pipeline
+using the same class, gets fresh instances. A worker that dies simply
 rebuilds on its replacement — no pool bookkeeping."""
 
 import collections

@@ -5,9 +5,9 @@ Deployments are async replica actors; handles route with power-of-two-choices;
 adds a continuous-batching LLM replica on a jitted decode step.
 """
 
-from .api import (delete, get_app_handle, get_deployment_handle,
-                  get_replica_context, grpc_port, run, shutdown, start,
-                  status)
+from .api import (HTTPOptions, delete, get_app_handle,
+                  get_deployment_handle, get_replica_context, grpc_port,
+                  run, run_many, shutdown, shutdown_async, start, status)
 from .asgi import ingress
 from .batching import batch
 from .deployment import (Application, AutoscalingConfig, Deployment,
@@ -24,6 +24,7 @@ __all__ = [
     "DeploymentResponse", "Request", "Response", "batch", "build_app_config",
     "Application", "delete", "deploy_config", "deployment",
     "get_app_handle", "get_deployment_handle", "get_replica_context",
+    "HTTPOptions", "run_many", "shutdown_async",
     "grpc_port",
     "get_multiplexed_model_id", "ingress", "multiplexed", "run", "shutdown",
     "start", "status", "PrefillServer", "DecodeServer", "PDServer",

@@ -6,7 +6,8 @@ root. No HTTP in round 1 — the handle API is the ingress; an asyncio proxy
 rides on it.
 """
 
-from typing import Dict, Optional
+import dataclasses
+from typing import Dict, List, Optional
 
 import cloudpickle
 
@@ -56,6 +57,28 @@ def run(target: BoundDeployment, *, name: str = "default",
     return handles[id(target)]
 
 
+@dataclasses.dataclass
+class HTTPOptions:
+    """Proxy bind options (ref: ray.serve.config.HTTPOptions) — accepted
+    by start() interchangeably with a plain dict."""
+    host: str = "127.0.0.1"
+    port: int = 8000
+
+
+def run_many(targets, **kwargs) -> List[DeploymentHandle]:
+    """Deploy several (app_name, bound_deployment) pairs (ref:
+    serve.run_many); returns their handles in order."""
+    return [run(t, name=n, **kwargs) for n, t in targets]
+
+
+async def shutdown_async() -> None:
+    """Async-context shutdown (ref: serve.shutdown_async): same teardown,
+    but safe to call from a running event loop where the sync version's
+    blocking gets would deadlock."""
+    import asyncio
+    await asyncio.get_running_loop().run_in_executor(None, shutdown)
+
+
 def start(detached: bool = True, http_options: Optional[Dict] = None,
           grpc_options: Optional[Dict] = None, **_compat):
     """Start the HTTP proxy (reference: serve.start). Returns the bound port
@@ -68,6 +91,8 @@ def start(detached: bool = True, http_options: Optional[Dict] = None,
     import ray_tpu
     if not ray_tpu.is_initialized():
         ray_tpu.init()
+    if isinstance(http_options, HTTPOptions):
+        http_options = dataclasses.asdict(http_options)
     opts = dict(http_options or {})
     _proxy, port = start_proxy(opts.get("host", "127.0.0.1"),
                                opts.get("port", 8000))

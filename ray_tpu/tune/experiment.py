@@ -60,7 +60,11 @@ def _class_to_function(cls, max_iters: int,
     report() raising TrainingStopped. With checkpoint_freq>0 the class's
     save_checkpoint hook runs every N iterations (and load_checkpoint on
     resume), so class trainables checkpoint exactly like function ones."""
+    _META = "_trainable_meta.json"
+
     def fn(config):
+        import json
+        import os
         import tempfile
 
         from ray_tpu.train.checkpoint import Checkpoint
@@ -69,12 +73,22 @@ def _class_to_function(cls, max_iters: int,
         start = get_checkpoint()
         if start is not None:
             t.load_checkpoint(start.path)
+            # restore the iteration counter alongside user state, so a
+            # resumed trial CONTINUES its training_iteration sequence and
+            # loop budget instead of rewinding to 1 (schedulers would see
+            # duplicate iterations and the stop criterion would overrun)
+            meta = os.path.join(start.path, _META)
+            if os.path.exists(meta):
+                with open(meta) as f:
+                    t.iteration = int(json.load(f)["iteration"])
         try:
-            for i in range(max_iters):
+            for i in range(t.iteration, max_iters):
                 result = t.train()
                 if checkpoint_freq and (i + 1) % checkpoint_freq == 0:
                     with tempfile.TemporaryDirectory() as d:
                         t.save_checkpoint(d)
+                        with open(os.path.join(d, _META), "w") as f:
+                            json.dump({"iteration": t.iteration}, f)
                         report(result, checkpoint=Checkpoint.from_directory(d))
                 else:
                     report(result)

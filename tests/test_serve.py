@@ -423,3 +423,31 @@ def test_replica_context_and_app_handle(serve_session):
     with pytest.raises(RuntimeError, match="replica"):
         serve.get_replica_context()   # driver side: not in a replica
     serve.delete("whoami")
+
+
+def test_run_many_http_options_shutdown_async(serve_session):
+    import asyncio
+
+    import ray_tpu
+    from ray_tpu import serve
+
+    @serve.deployment
+    def alpha():
+        return "a"
+
+    @serve.deployment
+    def beta():
+        return "b"
+
+    h1, h2 = serve.run_many([("many_a", alpha.bind()),
+                             ("many_b", beta.bind())])
+    assert h1.remote().result() == "a"
+    assert h2.remote().result() == "b"
+    port = serve.start(http_options=serve.HTTPOptions(port=0))
+    assert isinstance(port, int) and port > 0
+
+    async def drive():
+        await serve.shutdown_async()
+    asyncio.run(drive())
+    # everything torn down: a fresh status() finds no apps
+    assert serve.status() == {}
