@@ -64,11 +64,13 @@ class JaxTrainer:
         datasets: Optional[Dict[str, Any]] = None,
         resume_from_checkpoint: Optional[Checkpoint] = None,
         use_worker_actor: Optional[bool] = None,
+        data_config=None,
     ):
         self.train_loop = train_loop_per_worker
         self.train_loop_config = train_loop_config or {}
         self.scaling_config = scaling_config or ScalingConfig()
         self.run_config = run_config or RunConfig()
+        self.data_config = data_config
         self.datasets = datasets or {}
         self.resume_from_checkpoint = resume_from_checkpoint
         self.use_worker_actor = use_worker_actor
@@ -96,7 +98,8 @@ class JaxTrainer:
         else:
             out = run_training(self.train_loop, self.train_loop_config,
                                self.scaling_config, self.run_config,
-                               self.datasets, resume_path, run_id=run_id)
+                               self.datasets, resume_path, run_id=run_id,
+                               data_config=self.data_config)
         return Result(
             metrics=out["metrics"],
             checkpoint=Checkpoint(out["latest_ckpt"]) if out["latest_ckpt"] else None,
@@ -165,6 +168,13 @@ class JaxTrainer:
                     opts["resources"] = dict(
                         self.scaling_config.resources_per_worker)
                 Worker = ray_tpu.remote(**opts)(TrainWorker)
+                # split each dataset ONCE on the driver and ship only the
+                # rank's shard: letting every worker run _shard_datasets
+                # itself would execute the full pipeline N times and ship
+                # all rows to every rank just to keep 1/N
+                from .worker_group import presplit_datasets
+                per_rank = presplit_datasets(self.datasets,
+                                             self.data_config, n)
                 for rank in range(n):
                     strat = PlacementGroupSchedulingStrategy(
                         placement_group=pg,
@@ -173,8 +183,9 @@ class JaxTrainer:
                         scheduling_strategy=strat).remote(
                             blob, self.train_loop_config,
                             self.scaling_config, self.run_config,
-                            self.datasets, resume_path, run_id,
-                            world_rank=rank, world_size=n))
+                            per_rank[rank], resume_path, run_id,
+                            world_rank=rank, world_size=n,
+                            data_config=None))  # already sharded
                 coordinator = ray_tpu.get(
                     workers[0].coordinator_endpoint.remote(), timeout=120)
                 outs = ray_tpu.get(
@@ -230,7 +241,7 @@ class JaxTrainer:
         worker = Worker.remote(
             cloudpickle.dumps(self.train_loop), self.train_loop_config,
             self.scaling_config, self.run_config, self.datasets, resume_path,
-            run_id)
+            run_id, data_config=self.data_config)
         try:
             return ray_tpu.get(worker.run.remote())
         except Exception as e:  # noqa: BLE001 - actor died beyond retries

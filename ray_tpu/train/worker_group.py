@@ -26,7 +26,8 @@ from typing import Any, Callable, Dict, Optional
 
 from . import session as _session
 from .checkpoint import Checkpoint, _CheckpointBook
-from .config import CheckpointConfig, FailureConfig, RunConfig, ScalingConfig
+from .config import (CheckpointConfig, DataConfig, FailureConfig,
+                     RunConfig, ScalingConfig)
 
 _PROGRESS_FILE = "progress.jsonl"
 _RUN_ID_FILE = ".run_id"
@@ -100,12 +101,56 @@ def _append_history(exp_dir: str, metrics: Dict) -> None:
         pass
 
 
+def _shard_datasets(datasets: Dict[str, Any], data_config,
+                    world_size: int, world_rank: int) -> Dict[str, Any]:
+    """Per-worker dataset view (ref: train/_internal/data_config.py
+    DataConfig.configure): datasets named by DataConfig.datasets_to_split
+    ("all" by default) are row-partitioned so each rank trains on its own
+    shard; everything else (and non-Dataset iterables) replicates."""
+    if world_size <= 1 or not datasets:
+        return dict(datasets)
+    from ray_tpu.data import Dataset
+    cfg = data_config or DataConfig()
+    split = set(cfg.split_names(list(datasets)))
+    out = {}
+    for name, ds in datasets.items():
+        if name in split and isinstance(ds, Dataset):
+            # equal=True: unequal shards would run different numbers of
+            # batches per rank, deadlocking any per-batch SPMD collective
+            # (ref DataConfig.configure splits equal via streaming_split)
+            out[name] = ds.split(world_size, equal=True)[world_rank]
+        else:
+            out[name] = ds
+    return out
+
+
+def presplit_datasets(datasets: Dict[str, Any], data_config,
+                      n: int) -> list:
+    """Driver-side: split each to-be-split dataset ONCE into n shards and
+    return [datasets-for-rank-0, ..., datasets-for-rank-n-1]; replicated
+    entries appear in every rank's dict."""
+    from ray_tpu.data import Dataset
+    cfg = data_config or DataConfig()
+    split = set(cfg.split_names(list(datasets or {})))
+    per_rank = [dict() for _ in range(n)]
+    for name, ds in (datasets or {}).items():
+        if name in split and isinstance(ds, Dataset):
+            parts = ds.split(n, equal=True)
+            for r in range(n):
+                per_rank[r][name] = parts[r]
+        else:
+            for r in range(n):
+                per_rank[r][name] = ds
+    return per_rank
+
+
 def run_training(train_loop: Callable, train_loop_config: Dict,
                  scaling: ScalingConfig, run_cfg: RunConfig,
                  datasets: Dict[str, Any],
                  resume_ckpt_path: Optional[str],
                  stop_fn: Optional[Callable] = None,
-                 run_id: Optional[str] = None) -> Dict[str, Any]:
+                 run_id: Optional[str] = None,
+                 data_config=None) -> Dict[str, Any]:
     """The train-loop driver: runs `train_loop` under a session with
     report/checkpoint plumbing, retrying per FailureConfig. Runs either
     in-process (no runtime) or inside a TrainWorker actor. Returns a
@@ -192,7 +237,9 @@ def run_training(train_loop: Callable, train_loop_config: Dict,
             trial_id="train_0", trial_dir=exp_dir)
         _session.init_session(ctx, checkpoint=book.latest or start_ckpt,
                               report_fn=report_fn,
-                              dataset_shards=datasets)
+                              dataset_shards=_shard_datasets(
+                                  datasets, data_config,
+                                  world_size, world_rank))
         try:
             _call_loop()
             error = error_tb = None
@@ -294,9 +341,11 @@ class TrainWorker:
                  scaling: ScalingConfig, run_cfg: RunConfig,
                  datasets: Dict[str, Any], resume_ckpt_path: Optional[str],
                  run_id: Optional[str] = None,
-                 world_rank: int = 0, world_size: int = 1):
+                 world_rank: int = 0, world_size: int = 1,
+                 data_config=None):
         import cloudpickle
         self._loop = cloudpickle.loads(loop_blob)
+        self._data_config = data_config
         self._cfg = train_loop_config
         self._scaling = scaling
         self._run_cfg = run_cfg
@@ -346,7 +395,8 @@ class TrainWorker:
             self._join_world(coordinator)
         out = run_training(self._loop, self._cfg, self._scaling,
                            self._run_cfg, self._datasets, self._resume,
-                           run_id=self._run_id)
+                           run_id=self._run_id,
+                           data_config=self._data_config)
         if self._world_size > 1 and out.get("error") is not None:
             # group mode: RAISE so the trainer's get() fails, tears the
             # whole group down, and group-retries — returning an error dict

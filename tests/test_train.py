@@ -201,3 +201,41 @@ def test_iter_device_batches_with_sharding():
     batches = [np.arange(16, dtype=np.float32) for _ in range(3)]
     out = list(train.iter_device_batches(iter(batches), sharding=sh))
     assert out[0].sharding == sh
+
+
+def test_shard_datasets_respects_data_config():
+    """DataConfig drives which datasets split across ranks (ref:
+    train/_internal/data_config.py); others replicate."""
+    from ray_tpu import data as rd
+    from ray_tpu.train.config import DataConfig
+    from ray_tpu.train.worker_group import _shard_datasets
+
+    ds = {"train": rd.range(8), "val": rd.range(4)}
+    # default: split ALL, EQUAL shards (unequal counts would deadlock
+    # per-batch SPMD collectives)
+    r0 = _shard_datasets(ds, None, world_size=2, world_rank=0)
+    r1 = _shard_datasets(ds, None, world_size=2, world_rank=1)
+    assert r0["train"].count() == r1["train"].count() == 4
+    assert r0["val"].count() == r1["val"].count() == 2
+    ids0 = {r["id"] for r in r0["train"].take_all()}
+    ids1 = {r["id"] for r in r1["train"].take_all()}
+    assert ids0.isdisjoint(ids1)
+    # selective: only "train" splits, "val" replicates
+    cfg = DataConfig(datasets_to_split=["train"])
+    s0 = _shard_datasets(ds, cfg, world_size=2, world_rank=0)
+    assert s0["val"].count() == 4
+    assert s0["train"].count() < 8
+    # single worker: untouched
+    assert _shard_datasets(ds, None, 1, 0)["train"].count() == 8
+    # strings / iterables replicate, never .split()
+    mixed = _shard_datasets({"path": "gs://b/d", "train": rd.range(4)},
+                            None, 2, 0)
+    assert mixed["path"] == "gs://b/d"
+
+    # driver-side presplit: one split, equal shards, replicated extras
+    from ray_tpu.train.worker_group import presplit_datasets
+    per_rank = presplit_datasets(
+        {"train": rd.range(9), "note": "x"}, None, 2)
+    assert len(per_rank) == 2
+    assert per_rank[0]["train"].count() == per_rank[1]["train"].count() == 4
+    assert per_rank[0]["note"] == per_rank[1]["note"] == "x"
