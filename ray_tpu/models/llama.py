@@ -122,7 +122,17 @@ class KVCache(flax.struct.PyTreeNode):
     stay <= Smax. XLA's dynamic_update_slice clamps out-of-range starts, so an
     overflowing write would silently overwrite the cache tail instead of
     erroring — drivers (serve/llm, generate loops) must stop or evict at
-    capacity; a data-dependent raise can't live inside jit."""
+    capacity; a data-dependent raise can't live inside jit.
+
+    Scan contract: the decode-step program (t == 1) is also the body of
+    serve/llm's fused multi-token chunk — the cache is CARRIED through a
+    lax.scan, so the step must stay shape-stable with no host callbacks,
+    and the capacity invariant applies per scan step (the serve tick loop
+    clamps its chunk length to the row with the most remaining room). A
+    row whose length is frozen mid-scan (terminated slot) keeps taking one
+    masked write per step at that frozen position — garbage past `length`
+    is never readable (absolute-position mask) and is overwritten when the
+    row is reused."""
     k: Tuple[jax.Array, ...]
     v: Tuple[jax.Array, ...]
     length: jax.Array  # [B] int32

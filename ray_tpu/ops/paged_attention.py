@@ -278,6 +278,13 @@ def write_layer_tokens(cache: PagedKVCache, layer_idx: int, k_new: jax.Array,
     over the server's lifetime (VERDICT r3 weak #3: measured, documented,
     unrolled wins). Prefill (T > 1) keeps the batched scatter — it runs
     once per request, not once per generated token.
+
+    The T == 1 path is also the write primitive inside serve/llm's fused
+    multi-token decode chunk: the whole PagedKVCache is carried through a
+    lax.scan, and because DUS on the carried pool aliases in place, N
+    chunked steps cost N per-step writes — no pool copy per scan
+    iteration. Keep this path free of ops that break carry aliasing
+    (no reshapes of the pool, no scatter).
     """
     bsz, t, kh, d = k_new.shape
     ps = cache.page_size

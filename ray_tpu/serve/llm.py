@@ -4,10 +4,20 @@ TPU-first instead of wrapping vLLM's CUDA paged attention).
 
 Design: B decode slots over a static-shape KVCache ([B, Smax] per layer,
 per-row lengths). Requests are admitted into free slots (prefill fills the
-row's cache), and ONE jitted decode step advances every active slot each
-tick — XLA sees the same [B, 1] program forever, no recompiles, while
-requests join/leave between ticks (continuous batching). Sampling is
+row's cache), and ONE jitted decode call advances every active slot each
+tick — XLA sees the same program forever, no recompiles, while requests
+join/leave between ticks (continuous batching). Sampling is
 temperature/top-k on-device.
+
+The decode tick is a fused MULTI-TOKEN chunk (Podracer/Anakin lesson —
+keep the inner loop on device): a lax.scan runs up to `decode_chunk`
+[B, 1] steps — sampling, per-slot EOS/max-token/max-seq-len termination
+masking, logprob capture — in one jitted call with ONE host sync per
+chunk, so the per-token host round-trip (which dominates decode latency
+over the TPU relay) amortizes by N. The loop adapts: chunk 1 while
+prefill jobs are queued (continuous batching must admit promptly),
+`decode_chunk` in steady-state decode; streaming slots flush their queue
+once per chunk, in order.
 
 The per-row `length` mask plays the role of vLLM's page table in round 1:
 slot rows are the "pages", eviction = slot free. A pallas paged-attention
@@ -50,6 +60,17 @@ class LLMConfig:
     # decode steps, so a long prompt never stalls active streams for more
     # than one chunk's compute (VERDICT r3 weak #6).
     prefill_chunk: int = 128
+    # Fused multi-token decode (Podracer/Anakin: keep the inner loop on
+    # device): lax.scan runs up to this many decode steps per jitted call
+    # — sampling, EOS/max-token/max-seq-len termination masking and
+    # logprob capture included — with ONE host sync per chunk, so the
+    # per-token host round-trip (the decode-latency floor over a TPU
+    # relay) amortizes by N. The tick loop stays at chunk 1 while prefill
+    # jobs are queued (admission must not wait N steps) and while
+    # speculation is on (the draft check is per-tick), then ramps to this
+    # value in steady-state decode. 8 ≈ relay-RTT/step-time break-even at
+    # 125M–1B; runtime-adjustable via serve user_config → reconfigure().
+    decode_chunk: int = 8
     # Prefix caching (paged mode only; ref: the reference's sglang engine
     # serves RadixAttention prefix reuse): full prompt pages are
     # content-addressed and shared across requests with refcounts — a
@@ -234,6 +255,22 @@ class LLMServer:
         self._spec = None
         self._spec_stats = {"spec_ticks": 0, "decode_ticks": 0,
                             "drafted": 0, "accepted": 0}
+        # decode-chunk accounting: ONE host sync per chunk is the whole
+        # perf story, so it is a recorded metric (stats() + util.metrics),
+        # not an inference — decode_bench.py asserts on it
+        self._decode_stats = {"host_syncs": 0, "tokens": 0,
+                              "chunk_s_total": 0.0, "chunk_sizes": {}}
+        from ray_tpu.util import metrics as _metrics
+        self._m_syncs = _metrics.get_or_create(
+            _metrics.Counter, "serve_decode_host_syncs",
+            "decode engine host syncs (one per decode chunk / spec tick)")
+        self._m_tokens = _metrics.get_or_create(
+            _metrics.Counter, "serve_decode_tokens",
+            "tokens emitted by the decode engine")
+        self._m_chunk_ms = _metrics.get_or_create(
+            _metrics.Histogram, "serve_decode_chunk_latency_ms",
+            "wall latency of one fused decode chunk (ms)",
+            boundaries=[1, 2, 5, 10, 20, 50, 100, 200, 500, 1000])
         self._free = list(range(B))
         self._req_counter = 0
         self._tick_task = None
@@ -318,14 +355,6 @@ class LLMServer:
                 lengths=cache.lengths.at[slot].set(true_end))
             return new_cache, logits[0, true_end - start_len - 1]
 
-        def decode_paged(params, cache, last_tokens, active_mask, key,
-                         temps, top_ps, top_ks, want_logp):
-            logits, new_cache = model.apply(params, last_tokens, cache=cache)
-            nxt, logp = sample(logits[:, -1, :], key, temps, top_ps, top_ks,
-                               want_logp)
-            lengths = jnp.where(active_mask, new_cache.lengths, cache.lengths)
-            return new_cache.replace(lengths=lengths), nxt, logp
-
         def prefill_row(params, cache, tokens, slot, start_len, true_end):
             """Write one CHUNK of a (padded) prompt's KV into `slot`'s row;
             tokens: [1, C] padded to a bucket, covering prompt positions
@@ -347,16 +376,57 @@ class LLMServer:
             last = logits[0, true_end - start_len - 1]
             return KVCache(k=k, v=v, length=length), last
 
-        def decode_step(params, cache, last_tokens, active_mask, key,
-                        temps, top_ps, top_ks, want_logp):
-            """One token for every slot: [B, 1] forward + sample."""
-            logits, new_cache = model.apply(params, last_tokens, cache=cache)
-            nxt, logp = sample(logits[:, -1, :], key, temps, top_ps, top_ks,
-                               want_logp)
-            # inactive slots must not advance their cache row
-            length = jnp.where(active_mask, new_cache.length, cache.length)
-            new_cache = KVCache(k=new_cache.k, v=new_cache.v, length=length)
-            return new_cache, nxt, logp
+        def decode_chunk(params, cache, last_tokens, active_mask, key,
+                         temps, top_ps, top_ks, eos_ids, budgets, rooms,
+                         want_logp, n):
+            """`n` decode steps entirely ON DEVICE (the tentpole): lax.scan
+            over the same [B, 1] forward + sample() the per-step loop ran,
+            with per-slot termination folded into the scan — a slot stops
+            the step it hits its EOS id, its token budget, or its cache
+            row's capacity, and stopped slots stay frozen (length pinned,
+            last token pinned) while the rest continue. ONE host sync per
+            chunk instead of per token.
+
+            Returns (cache, tokens [B, n], n_valid [B], logps [B, n],
+            key'): tokens[i, j] is valid iff j < n_valid[i] — termination
+            is a prefix property. Key discipline matches the host loop
+            exactly (one jax.random.split per step, final carried key
+            handed back), so a chunk of n is bit-identical to n per-step
+            ticks — parity-tested in tests/test_llm_decode_chunk.py.
+
+            Steps after a slot stops still write one KV entry at its
+            frozen length (masked on read, overwritten on slot reuse) —
+            the same contract inactive slots already had under the
+            per-step loop, for both cache layouts."""
+
+            def one_step(carry, _):
+                cache, last, active, emitted, key = carry
+                key, sub = jax.random.split(key)
+                logits, new_cache = model.apply(params, last[:, None],
+                                                cache=cache)
+                nxt, logp = sample(logits[:, -1, :], sub, temps, top_ps,
+                                   top_ks, want_logp)
+                emitted = emitted + active.astype(jnp.int32)
+                done = ((nxt == eos_ids) | (emitted >= budgets)
+                        | (emitted >= rooms))
+                still = active & ~done
+                # slots not active THIS step must not advance their row
+                if cfg.paged:
+                    new_cache = new_cache.replace(lengths=jnp.where(
+                        active, new_cache.lengths, cache.lengths))
+                else:
+                    new_cache = KVCache(
+                        k=new_cache.k, v=new_cache.v,
+                        length=jnp.where(active, new_cache.length,
+                                         cache.length))
+                last = jnp.where(still, nxt, last)
+                return (new_cache, last, still, emitted, key), (nxt, logp)
+
+            init = (cache, last_tokens, active_mask,
+                    jnp.zeros_like(last_tokens), key)
+            (cache, _, _, n_valid, key), (toks, logps) = jax.lax.scan(
+                one_step, init, None, length=n)
+            return cache, toks.T, n_valid, logps.T, key
 
         def spec_step(params, cache, tokens, active_mask, key,
                       temps, top_ps, top_ks, want_logp):
@@ -401,21 +471,70 @@ class LLMServer:
         if cfg.paged:
             self._prefill = jax.jit(prefill_paged, donate_argnums=(1,),
                                     static_argnums=(6,))
-            self._decode = jax.jit(decode_paged, donate_argnums=(1,),
-                                   static_argnums=(8,))
         else:
             self._prefill = jax.jit(prefill_row, donate_argnums=(1,))
-            self._decode = jax.jit(decode_step, donate_argnums=(1,),
-                                   static_argnums=(8,))
             if cfg.speculate > 0:
                 self._spec = jax.jit(spec_step, donate_argnums=(1,),
                                      static_argnums=(8,))
+        # one compiled variant per (want_logp, chunk length); chunk lengths
+        # are power-of-two bucketed by _chunk_len so the variant count stays
+        # O(log decode_chunk), and n=1 IS the old per-step program
+        self._decode_chunk = jax.jit(decode_chunk, donate_argnums=(1,),
+                                     static_argnums=(11, 12))
         # first token goes through the SAME sampling policy as later ones
         self._sample_first = jax.jit(
             lambda logits, key, t, p, k, want_logp=True: tuple(
                 x[0] for x in sample(logits[None], key, t[None], p[None],
                                      k[None], want_logp)),
             static_argnums=(5,))
+
+    def _chunk_len(self) -> int:
+        """Adaptive decode-chunk length for THIS tick. Chunk 1 while any
+        prompt is still prefilling (a queued request must not wait N device
+        steps for its next chunk) and while speculation is on (the draft
+        check runs per tick); otherwise min(decode_chunk, most remaining
+        tokens over active slots), bucketed DOWN to a power of two so the
+        jit cache holds O(log decode_chunk) variants, same idiom as the
+        prefill buckets."""
+        cfg = self.config
+        if cfg.decode_chunk <= 1 or self._prefill_q or cfg.speculate > 0:
+            return 1
+        rem = 1
+        for slot in self._active.values():
+            rem = max(rem, min(
+                slot.max_tokens - len(slot.generated),
+                cfg.max_seq_len - (slot.prompt_len + len(slot.generated))))
+        n = min(cfg.decode_chunk, rem)
+        return 1 << (max(n, 1).bit_length() - 1)
+
+    def _note_sync(self, tokens: int, dt_s: float,
+                   chunk: Optional[int] = None):
+        """Record one host sync of the decode engine (a fused chunk or a
+        speculative verify tick)."""
+        st = self._decode_stats
+        st["host_syncs"] += 1
+        st["tokens"] += tokens
+        st["chunk_s_total"] += dt_s
+        if chunk is not None:
+            st["chunk_sizes"][chunk] = st["chunk_sizes"].get(chunk, 0) + 1
+        self._m_syncs.inc()
+        if tokens:
+            self._m_tokens.inc(tokens)
+        self._m_chunk_ms.observe(dt_s * 1e3)
+
+    def reconfigure(self, user_config: Optional[Dict[str, Any]]):
+        """Serve `user_config` hook (replica.py calls this at deployment
+        and on in-place updates): adjust engine knobs that need neither a
+        param reload nor a cache rebuild. `decode_chunk` is the first such
+        knob — the jit cache keys on the chunk length, so a new value just
+        compiles its variant on first use."""
+        if not user_config:
+            return
+        if "decode_chunk" in user_config:
+            n = int(user_config["decode_chunk"])
+            if n < 1:
+                raise ValueError(f"decode_chunk must be >= 1, got {n}")
+            self.config.decode_chunk = n
 
     def _bucket(self, n: int) -> int:
         """Pad prompt lengths to power-of-two buckets: few compiled prefill
@@ -664,12 +783,15 @@ class LLMServer:
         self._capacity_event.set()  # wake admission waiters
 
     async def _tick_loop_inner(self):
-        """The continuous-batching engine: each iteration runs one decode
-        step for every active slot AND (at most) one prefill chunk of the
-        oldest queued prompt — a long prompt adds one chunk of latency per
-        generated token instead of stalling every stream for its full
-        prefill (chunked prefill; ref: the reference's PD-disaggregation
-        serving pattern)."""
+        """The continuous-batching engine: each iteration runs ONE fused
+        decode chunk (1.._chunk_len() on-device steps, one host sync) for
+        every active slot AND (at most) one prefill chunk of the oldest
+        queued prompt — a long prompt adds one chunk of latency per tick
+        instead of stalling every stream for its full prefill (chunked
+        prefill; ref: the reference's PD-disaggregation serving pattern).
+        While prompts are queued the decode chunk stays at 1, so admission
+        latency never grows with decode_chunk; streaming slots' queues are
+        flushed once per chunk, in token order."""
         import jax
         import jax.numpy as jnp
 
@@ -711,10 +833,12 @@ class LLMServer:
                     top_ks[i] = slot.top_k
                 any_logp = any(s.want_logprobs
                                for s in self._active.values())
-                self._sample_key, sub = jax.random.split(self._sample_key)
                 finished = []
+                t0 = time.perf_counter()
                 if drafts is not None:
                     # speculative tick: one [B, K+1] verify forward
+                    self._sample_key, sub = jax.random.split(
+                        self._sample_key)
                     toks = np.zeros((B, K + 1), np.int32)
                     for i, slot in self._active.items():
                         toks[i, 0] = slot.generated[-1]
@@ -724,12 +848,13 @@ class LLMServer:
                         self.params, self.cache, jnp.asarray(toks),
                         jnp.asarray(mask), sub, jnp.asarray(temps),
                         jnp.asarray(top_ps), jnp.asarray(top_ks), any_logp)
-                    emit = np.asarray(jax.device_get(emit))
-                    n_emit = np.asarray(jax.device_get(n_emit))
-                    logp = np.asarray(jax.device_get(logp))
+                    emit, n_emit, logp = (
+                        np.asarray(x) for x in jax.device_get(
+                            (emit, n_emit, logp)))
                     st = self._spec_stats
                     st["spec_ticks"] += 1
                     st["drafted"] += sum(len(d) for d in drafts.values())
+                    emitted = 0
                     for i, slot in self._active.items():
                         cnt = int(n_emit[i])
                         if i in drafts:
@@ -738,24 +863,52 @@ class LLMServer:
                             # output) but must not count as acceptance
                             st["accepted"] += min(cnt - 1, len(drafts[i]))
                         for j in range(cnt):
+                            emitted += 1
                             if emit_one(slot, int(emit[i, j]),
                                         float(logp[i, j])):
                                 finished.append(i)
                                 break
+                    self._note_sync(emitted, time.perf_counter() - t0)
                 else:
-                    last = np.zeros((B, 1), np.int32)
+                    # fused multi-token decode: n steps on device, ONE sync.
+                    # The chunk fn splits the sample key once per step and
+                    # returns the carried key — the same key stream the
+                    # per-step loop consumed, so chunking never changes
+                    # sampled outputs.
+                    n = self._chunk_len()
+                    last = np.zeros((B,), np.int32)
+                    eos = np.full((B,), -1, np.int32)   # -1 never matches
+                    budget = np.zeros((B,), np.int32)
+                    room = np.zeros((B,), np.int32)
                     for i, slot in self._active.items():
-                        last[i, 0] = slot.generated[-1]
-                    self.cache, nxt, logp = self._decode(
-                        self.params, self.cache, jnp.asarray(last),
-                        jnp.asarray(mask), sub, jnp.asarray(temps),
-                        jnp.asarray(top_ps), jnp.asarray(top_ks), any_logp)
-                    nxt = np.asarray(jax.device_get(nxt))
-                    logp = np.asarray(jax.device_get(logp))
+                        last[i] = slot.generated[-1]
+                        if slot.eos_id is not None:
+                            eos[i] = slot.eos_id
+                        budget[i] = slot.max_tokens - len(slot.generated)
+                        room[i] = self.config.max_seq_len - (
+                            slot.prompt_len + len(slot.generated))
+                    self.cache, toks, n_valid, logp, self._sample_key = \
+                        self._decode_chunk(
+                            self.params, self.cache, jnp.asarray(last),
+                            jnp.asarray(mask), self._sample_key,
+                            jnp.asarray(temps), jnp.asarray(top_ps),
+                            jnp.asarray(top_ks), jnp.asarray(eos),
+                            jnp.asarray(budget), jnp.asarray(room),
+                            any_logp, n)
+                    toks, n_valid, logp = (
+                        np.asarray(x) for x in jax.device_get(
+                            (toks, n_valid, logp)))
                     self._spec_stats["decode_ticks"] += 1
+                    emitted = 0
                     for i, slot in self._active.items():
-                        if emit_one(slot, int(nxt[i]), float(logp[i])):
-                            finished.append(i)
+                        for j in range(int(n_valid[i])):
+                            emitted += 1
+                            if emit_one(slot, int(toks[i, j]),
+                                        float(logp[i, j])):
+                                finished.append(i)
+                                break
+                    self._note_sync(emitted, time.perf_counter() - t0,
+                                    chunk=n)
                 for i in finished:
                     slot = self._active.pop(i)
                     slot.done_event.set()
@@ -885,6 +1038,20 @@ class LLMServer:
     def stats(self) -> Dict[str, Any]:
         s = {"active": len(self._active), "free_slots": len(self._free),
              "requests": self._req_counter}
+        st = self._decode_stats
+        s["decode"] = {
+            "decode_chunk": self.config.decode_chunk,
+            "host_syncs": st["host_syncs"],
+            "tokens": st["tokens"],
+            "tokens_per_sync": round(
+                st["tokens"] / max(st["host_syncs"], 1), 2),
+            "host_syncs_per_token": round(
+                st["host_syncs"] / max(st["tokens"], 1), 5),
+            "chunk_s_total": round(st["chunk_s_total"], 4),
+            "chunk_ms_avg": round(
+                st["chunk_s_total"] / max(st["host_syncs"], 1) * 1e3, 3),
+            "chunk_sizes": dict(st["chunk_sizes"]),
+        }
         if self.config.speculate > 0:
             st = dict(self._spec_stats)
             st["accept_rate"] = round(

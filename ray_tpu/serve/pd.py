@@ -96,7 +96,14 @@ class PrefillServer(LLMServer):
 
 class DecodeServer(LLMServer):
     """Decode replica that can admit a request whose prompt KV was computed
-    elsewhere: install pages, skip prefill entirely, decode as usual."""
+    elsewhere: install pages, skip prefill entirely, decode as usual.
+
+    Decode here means the inherited fused multi-token tick (llm.py
+    decode_chunk): with no local prefill queue competing, a pure-decode
+    replica sits in steady state almost immediately, so PD decode is the
+    best case for host-sync amortization — each tick advances every slot
+    up to `decode_chunk` tokens with one host round-trip. stats()['decode']
+    (tokens_per_sync, chunk latency) reports it per replica."""
 
     async def _admit_with_kv(self, prompt: List[int], kv: Dict[str, Any],
                              max_tokens: int, eos_id, stream: bool,

@@ -16,6 +16,12 @@ round-trips from `build_app_config`.
             num_replicas: 2
             user_config: {beam: 4}
             max_ongoing_requests: 16
+
+`user_config` reaches the replica through `instance.reconfigure(...)`
+(replica.py) when the deployment class defines it — e.g. an LLMServer
+deployment takes `user_config: {decode_chunk: 16}` to retune the fused
+decode-chunk length at deploy time without a param reload (llm.py
+LLMServer.reconfigure).
 """
 
 import dataclasses

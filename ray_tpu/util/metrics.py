@@ -114,6 +114,24 @@ class Histogram(Metric):
                     "sum": dict(self._sums), "count": dict(self._counts)}
 
 
+def get_or_create(metric_cls, name: str, *args, **kwargs) -> "Metric":
+    """Return the metric registered under `name`, constructing it on first
+    use. Metric.__init__ REPLACES a same-name registration, which silently
+    forks the series when several instances of a component (e.g. every
+    LLMServer replica in one process) each build their own — shared series
+    must go through here. Raises TypeError if `name` is already registered
+    as a different metric class."""
+    with _registry_lock:
+        existing = _registry.get(name)
+    if existing is not None:
+        if not isinstance(existing, metric_cls):
+            raise TypeError(
+                f"metric {name!r} already registered as "
+                f"{type(existing).__name__}, not {metric_cls.__name__}")
+        return existing
+    return metric_cls(name, *args, **kwargs)
+
+
 def collect() -> List[Dict]:
     """Snapshot every metric registered in this process."""
     with _registry_lock:

@@ -30,6 +30,9 @@ import bench  # noqa: E402
 @pytest.fixture(autouse=True)
 def _fast_watchdog(monkeypatch):
     monkeypatch.setenv("RAY_TPU_BENCH_INIT_WATCHDOG_S", "2")
+    # no test here may litter benchmarks/results/ — the artifact tests
+    # opt back in against a tmp_path RESULTS_DIR
+    monkeypatch.setenv("RAY_TPU_BENCH_WRITE_RESULTS", "0")
     yield
 
 
@@ -208,6 +211,8 @@ def test_end_to_end_fake_hang_falls_to_cpu_scrub():
         "RAY_TPU_BENCH_INIT_WATCHDOG_S": "30",
         "RAY_TPU_BENCH_BUDGET_S": "600",
         "RAY_TPU_BENCH_TRAIN_ONLY": "1",
+        # children succeed for real here — don't litter benchmarks/results/
+        "RAY_TPU_BENCH_WRITE_RESULTS": "0",
     })
     t0 = time.monotonic()
     r = subprocess.run([sys.executable, os.path.join(REPO, "bench.py")],
@@ -221,6 +226,169 @@ def test_end_to_end_fake_hang_falls_to_cpu_scrub():
     assert rec["value"] > 0
     # 2 watchdog kills (~3s each) + CPU measure; far under the r4 2×1500s
     assert elapsed < 540
+
+
+def test_write_result_artifact_roundtrip(tmp_path, monkeypatch):
+    """Successful records persist as <tag>_<UTC ts>.json under the results
+    dir (r6 satellite: perf claims become committed, diffable artifacts)."""
+    monkeypatch.setenv("RAY_TPU_BENCH_RESULTS_DIR", str(tmp_path))
+    monkeypatch.delenv("RAY_TPU_BENCH_WRITE_RESULTS", raising=False)
+    rec = {"metric": "train_tok_s", "value": 123.4}
+    path = bench._write_result_artifact("llama_1b", rec)
+    assert path is not None and os.path.dirname(path) == str(tmp_path)
+    name = os.path.basename(path)
+    assert name.startswith("llama_1b_") and name.endswith(".json")
+    with open(path) as f:
+        assert json.load(f) == rec
+
+
+def test_write_result_artifact_kill_switch(tmp_path, monkeypatch):
+    """RAY_TPU_BENCH_WRITE_RESULTS=0 disables writes — tests that spawn
+    real children rely on this to keep the repo clean."""
+    monkeypatch.setenv("RAY_TPU_BENCH_RESULTS_DIR", str(tmp_path))
+    monkeypatch.setenv("RAY_TPU_BENCH_WRITE_RESULTS", "0")
+    assert bench._write_result_artifact("x", {"v": 1}) is None
+    assert not list(tmp_path.iterdir())
+
+
+def test_run_child_writes_artifact_on_success(tmp_path, monkeypatch):
+    """_run_child persists every successful measure record, tagging the
+    CPU-scrub rung with a _cpu suffix so fallback numbers are never
+    mistaken for accelerator numbers."""
+    monkeypatch.setenv("RAY_TPU_BENCH_RESULTS_DIR", str(tmp_path))
+    monkeypatch.delenv("RAY_TPU_BENCH_WRITE_RESULTS", raising=False)
+    monkeypatch.setenv("RAY_TPU_BENCH_BUDGET_S",
+                       str(time.monotonic() - bench._T_START + 3000))
+
+    def spy(cmd, env, timeout, watch_init=True):
+        return 0, '{"metric": "m_cpu", "value": 2.0}\n', "", None
+
+    monkeypatch.setattr(bench, "_popen_watched", spy)
+    result, reason = bench._run_child("llama_125m", cpu_scrub=True)
+    assert result is not None and reason is None
+    files = sorted(os.listdir(tmp_path))
+    assert len(files) == 1 and files[0].startswith("llama_125m_cpu_")
+
+
+def test_aux_ladder_falls_to_cpu_scrub(tmp_path, monkeypatch, capsys):
+    """run_aux_ladder (r6 satellite: serving/rllib benches get bench.py's
+    resilience): the parent prints its own sentinel immediately (no jax →
+    can't wedge), the accel rung init-hangs at the watchdog, the CPU-scrub
+    rung's record wins, gains backend=cpu, is persisted, and the final
+    JSON line + rc 0 reach the caller."""
+    monkeypatch.setenv("RAY_TPU_BENCH_RESULTS_DIR", str(tmp_path))
+    monkeypatch.delenv("RAY_TPU_BENCH_WRITE_RESULTS", raising=False)
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)  # accel rung exists
+    calls = []
+
+    def fake_popen(cmd, env, timeout, watch_init=True):
+        calls.append((env.get("JAX_PLATFORMS"), timeout))
+        if env.get("JAX_PLATFORMS") != "cpu":
+            return -9, "", "", "init_hang"          # the wedged relay
+        return 0, '{"dense": {"decode_tps": 9.0}}\n', "", None
+
+    monkeypatch.setattr(bench, "_popen_watched", fake_popen)
+    rc = bench.run_aux_ladder("/x/serving_bench.py", budget_s=900.0)
+    assert rc == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert lines[0].startswith(bench._INIT_SENTINEL)
+    rec = json.loads(lines[-1])
+    assert rec["backend"] == "cpu"
+    assert rec["dense"] == {"decode_tps": 9.0}
+    # rung order: inherited-env accel attempt, then the CPU scrub
+    assert [c[0] for c in calls] == [None, "cpu"]
+    # both rungs clamp to the per-rung ceiling (and the accel rung had
+    # already reserved the CPU rung's 420s turn out of the 900s budget)
+    assert all(t <= 420.0 for _, t in calls)
+    files = os.listdir(tmp_path)
+    assert len(files) == 1 and files[0].startswith("serving_bench_cpu_")
+
+
+def test_aux_ladder_skips_accel_rung_when_scrubbed(monkeypatch, capsys):
+    """In an already-CPU-scrubbed environment there is no accel rung to
+    try — one child, and a record that still carries `backend`."""
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("RAY_TPU_BENCH_WRITE_RESULTS", "0")
+    calls = []
+
+    def fake_popen(cmd, env, timeout, watch_init=True):
+        calls.append(env.get("JAX_PLATFORMS"))
+        return 0, '{"ppo_env_steps_per_sec": 5.0}\n', "", None
+
+    monkeypatch.setattr(bench, "_popen_watched", fake_popen)
+    rc = bench.run_aux_ladder("/x/rllib_bench.py", budget_s=600.0)
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["backend"] == "cpu" and calls == ["cpu"]
+
+
+def test_aux_ladder_reports_all_rungs_failed(monkeypatch, capsys):
+    """Every rung failing still yields rc 0 and a final JSON line — an aux
+    bench must never sink the orchestrator's round — with the per-rung
+    reasons recorded for the postmortem."""
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("RAY_TPU_BENCH_WRITE_RESULTS", "0")
+    monkeypatch.setattr(bench, "_popen_watched",
+                        lambda cmd, env, timeout, watch_init=True:
+                        (-9, "", "", "init_hang"))
+    rc = bench.run_aux_ladder("/x/serving_bench.py", budget_s=900.0)
+    assert rc == 0
+    rec = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rec["backend"] == "none"
+    assert "accel: init_hang" in rec["error"]
+    assert "cpu: init_hang" in rec["error"]
+
+
+@pytest.mark.slow
+def test_serving_bench_wedged_relay_records_cpu_backend():
+    """Integration (r6 acceptance): serving_bench.py run WITHOUT flags vs a
+    simulated wedged relay must exit 0 with a final JSON record carrying
+    backend=cpu — the exact r5 failure ({"error": "init_hang"}), replayed
+    against the self-orchestrating ladder."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the accel rung "try" the relay
+    env.update({
+        "RAY_TPU_BENCH_FAKE_HANG": "600",
+        "RAY_TPU_BENCH_INIT_WATCHDOG_S": "20",
+        # > cpu_timeout_s (420) so the accel rung actually runs (it
+        # reserves the CPU rung's full turn before taking its own)
+        "RAY_TPU_AUX_BUDGET_S": "500",
+        "RAY_TPU_BENCH_WRITE_RESULTS": "0",
+        "B": "2", "MAX_TOKENS": "4", "PROMPT_LEN": "8", "ROUNDS": "1",
+        "SECTIONS": "dense",
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "serving_bench.py")],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = bench._parse_json_tail(r.stdout)
+    assert rec is not None, r.stdout[-500:]
+    assert rec["backend"] == "cpu"
+    assert rec["dense"]["decode_tps"] > 0
+    assert rec["dense"]["host_syncs_per_token"] <= 1.0
+
+
+@pytest.mark.slow
+def test_rllib_bench_wedged_relay_records_cpu_backend():
+    """Same wedged-relay replay for rllib_bench.py."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update({
+        "RAY_TPU_BENCH_FAKE_HANG": "600",
+        "RAY_TPU_BENCH_INIT_WATCHDOG_S": "20",
+        "RAY_TPU_AUX_BUDGET_S": "500",
+        "RAY_TPU_BENCH_WRITE_RESULTS": "0",
+        "BUDGET_S": "2",
+        "RLLIB_BENCH_MULTINODE": "0",
+    })
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "rllib_bench.py")],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = bench._parse_json_tail(r.stdout)
+    assert rec is not None, r.stdout[-500:]
+    assert rec["backend"] == "cpu"
+    assert rec["ppo_env_steps_per_sec"] > 0
 
 
 def test_late_tpu_retry_replaces_cpu_fallback(monkeypatch, capsys):
