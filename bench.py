@@ -594,7 +594,8 @@ def orchestrate():
         for key, script, tmo, extra in (
                 ("serving_b8", "serving_bench.py", 900, {"B": "8"}),
                 ("serving_b32", "serving_bench.py", 900, {"B": "32"}),
-                ("rllib_ppo", "rllib_bench.py", 600, None)):
+                ("rllib_ppo", "rllib_bench.py", 600, None),
+                ("core_cp", "core_bench.py", 300, None)):
             result[key] = _run_aux_bench(script, tmo, extra)
             # re-emit the merged-so-far record (NOT a bare keyed line): the
             # last complete JSON line on stdout is always a full headline

@@ -1,0 +1,165 @@
+"""Core control-plane benchmark: many-small-tasks throughput + submit
+latency, pipelined vs blocking submit (PR 2 tentpole).
+
+Measures the cost of the driver→controller control plane with no-op tasks:
+
+  * submit p50/p99 latency per `.remote()` call
+  * submit-phase tasks/sec (how fast the driver can issue work)
+  * end-to-end tasks/sec (submit + get of all results)
+  * blocking controller round trips charged to the submit phase
+    (util.metrics.control_roundtrips_total deltas — pipelined submit must
+    stay ≤ 1 per N tasks)
+  * a worker-side fanout section (a task that itself submits M children),
+    exercising the WorkerClient fire-and-forget path over the unix socket
+
+Both modes run in ONE process: the blocking baseline is the same build with
+RAY_TPU_SYNC_SUBMIT=1 (the escape-hatch env var), so the comparison isolates
+the pipelined control plane rather than a code-version diff. `speedup` is
+the pipelined/blocking ratio of submit-phase tasks/sec; `speedup_e2e` is the
+same ratio for end-to-end completion.
+
+Modes:
+  --measure   real measurement child (run by run_aux_ladder)
+  --smoke     fast CPU correctness check: pipelined mode only, asserts the
+              ≤ 1 round-trip invariant (tier-1 test hook)
+  (no flag)   self-orchestrating parent: bench.run_aux_ladder resilience
+              ladder, persists the rung record under benchmarks/results/
+
+This bench never imports jax — the control plane is accelerator-agnostic —
+so the init sentinel prints immediately and the CPU-scrub rung measures the
+identical thing.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# keep ray_tpu.init() from importing jax for chip discovery (r4 lesson:
+# backend probes can wedge under a broken accelerator runtime)
+os.environ.setdefault("RAY_TPU_NUM_CHIPS", "0")
+
+N = int(os.environ.get("RAY_TPU_CORE_BENCH_N", 400))
+FANOUT_M = int(os.environ.get("RAY_TPU_CORE_BENCH_FANOUT", 32))
+NUM_CPUS = int(os.environ.get("RAY_TPU_CORE_BENCH_CPUS", 4))
+
+
+def _percentile(sorted_vals, p):
+    return sorted_vals[min(int(len(sorted_vals) * p), len(sorted_vals) - 1)]
+
+
+def _fanout_fn(m):
+    """Runs INSIDE a worker: submit m children and report the blocking
+    round trips the submit phase cost this worker process."""
+    import ray_tpu
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    def _child(i):
+        return i
+
+    rt0 = metrics.control_roundtrips_total()
+    refs = [_child.remote(i) for i in range(m)]
+    submit_rt = metrics.control_roundtrips_total() - rt0
+    vals = ray_tpu.get(refs)
+    return {"submit_rt": submit_rt, "ok": vals == list(range(m))}
+
+
+def run_mode(sync: bool, n: int, fanout_m: int):
+    """One init→measure→shutdown cycle. `sync` selects the blocking
+    baseline via the RAY_TPU_SYNC_SUBMIT escape hatch (read at client
+    construction and inherited by workers at spawn)."""
+    os.environ["RAY_TPU_SYNC_SUBMIT"] = "1" if sync else "0"
+    import ray_tpu
+    from ray_tpu.util import metrics
+
+    @ray_tpu.remote
+    def _noop(i):
+        return i
+
+    _fanout = ray_tpu.remote(_fanout_fn)
+
+    ray_tpu.init(num_cpus=NUM_CPUS)
+    try:
+        # warmup: spawn workers, prime cloudpickle/function caches
+        ray_tpu.get([_noop.remote(i) for i in range(8)])
+
+        lat = []
+        rt0 = metrics.control_roundtrips_total()
+        t0 = time.perf_counter()
+        refs = []
+        for i in range(n):
+            s = time.perf_counter()
+            refs.append(_noop.remote(i))
+            lat.append(time.perf_counter() - s)
+        t_submit = time.perf_counter() - t0
+        submit_rt = metrics.control_roundtrips_total() - rt0
+        vals = ray_tpu.get(refs)
+        t_e2e = time.perf_counter() - t0
+        assert vals == list(range(n)), "wrong results"
+
+        fan = ray_tpu.get(_fanout.remote(fanout_m))
+        assert fan["ok"], "fanout children returned wrong results"
+        lat.sort()
+        return {
+            "n": n,
+            "submit_p50_us": round(_percentile(lat, 0.50) * 1e6, 1),
+            "submit_p99_us": round(_percentile(lat, 0.99) * 1e6, 1),
+            "submit_tps": round(n / t_submit, 1),
+            "e2e_tps": round(n / t_e2e, 1),
+            "submit_roundtrips": submit_rt,
+            "fanout": fan,
+        }
+    finally:
+        ray_tpu.shutdown()
+
+
+def measure():
+    from bench import _INIT_SENTINEL  # repo root on sys.path (line 36)
+    # no jax import here — the control plane can't wedge on a backend, so
+    # the watchdog sentinel goes out immediately
+    print(f"{_INIT_SENTINEL} backend=control-plane", file=sys.stderr,
+          flush=True)
+    # throwaway cycle: pay one-time import/worker-spawn warmness before
+    # either timed mode (ordering would otherwise favor whichever runs
+    # second)
+    run_mode(sync=False, n=8, fanout_m=4)
+    out = {"bench": "core_control_plane", "backend": "control-plane",
+           "n": N, "fanout_m": FANOUT_M, "num_cpus": NUM_CPUS}
+    out["blocking"] = run_mode(sync=True, n=N, fanout_m=FANOUT_M)
+    out["pipelined"] = run_mode(sync=False, n=N, fanout_m=FANOUT_M)
+    out["speedup"] = round(
+        out["pipelined"]["submit_tps"] / max(out["blocking"]["submit_tps"],
+                                             1e-9), 2)
+    out["speedup_e2e"] = round(
+        out["pipelined"]["e2e_tps"] / max(out["blocking"]["e2e_tps"],
+                                          1e-9), 2)
+    print(json.dumps(out))
+
+
+def smoke():
+    """Fast tier-1 hook: pipelined mode only, asserts the control-plane
+    invariant (≤ 1 blocking round trip for the whole submit phase, driver
+    AND worker side)."""
+    n = int(os.environ.get("RAY_TPU_CORE_BENCH_N", 32))
+    rec = run_mode(sync=False, n=n, fanout_m=8)
+    assert rec["submit_roundtrips"] <= 1, (
+        f"pipelined submit cost {rec['submit_roundtrips']} round trips "
+        f"for {n} tasks (expected ≤ 1)")
+    assert rec["fanout"]["submit_rt"] <= 1, (
+        f"worker fanout submit cost {rec['fanout']['submit_rt']} round "
+        f"trips (expected ≤ 1)")
+    print(json.dumps({"bench": "core_control_plane_smoke", **rec}))
+
+
+if __name__ == "__main__":
+    if "--measure" in sys.argv[1:]:
+        measure()
+    elif "--smoke" in sys.argv[1:]:
+        smoke()
+    else:
+        # parent mode: resilience ladder (persists the result artifact)
+        from bench import run_aux_ladder
+        sys.exit(run_aux_ladder(os.path.abspath(__file__)))

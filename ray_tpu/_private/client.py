@@ -3,11 +3,26 @@
 Reference split: python/ray/_private/worker.py (driver/worker modes) over the
 cython core_worker. Both clients expose the same surface so `ray_tpu.api`
 works identically in driver code and inside tasks/actors.
+
+Pipelined control plane (ref: Ray's async SubmitTask + batched
+reference-count RPCs, core_worker.cc / reference_count.cc):
+
+- `submit` derives the return-object ids locally (ids.object_id_for_return)
+  and ships the spec fire-and-forget; submission errors surface through the
+  refs' descriptors. `RAY_TPU_SYNC_SUBMIT=1` restores the blocking path.
+- refcount/stream deltas and put registrations coalesce in a _DeltaFlusher
+  and travel as single multi-entry "batch" frames. Ordering contract: every
+  OTHER frame on the channel (blocking RPCs, fire-and-forget sends, the
+  pipelined submit itself) forces a flush first, so a batch entry can never
+  be applied after a frame that was issued later — and a decref can never
+  overtake the put that created its ref.
 """
 
 import concurrent.futures
+import os
 import socket
 import threading
+import time
 import asyncio
 
 from .. import exceptions as exc
@@ -16,6 +31,107 @@ from .object_store import StoreClient
 from .task_spec import TaskSpec
 
 _INLINE_MAX = 64 * 1024
+
+# flush when a batch accumulates this many entries / inline-put bytes, or
+# when the short timer fires — whichever comes first
+_FLUSH_MAX_ENTRIES = int(os.environ.get("RAY_TPU_FLUSH_MAX_ENTRIES", "128"))
+_FLUSH_MAX_BYTES = int(os.environ.get("RAY_TPU_FLUSH_MAX_BYTES",
+                                      str(256 * 1024)))
+_FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_FLUSH_INTERVAL_S", "0.005"))
+
+
+def _sync_submit_requested() -> bool:
+    return os.environ.get("RAY_TPU_SYNC_SUBMIT", "").lower() in (
+        "1", "true", "yes")
+
+
+class _DeltaFlusher:
+    """Coalesces small control messages into ordered multi-entry batches.
+
+    Entries are applied by the controller strictly in append order. The sink
+    runs UNDER the flusher lock, so concurrent drains cannot reorder (an
+    older batch always reaches the controller before a younger one). The
+    lock is reentrant because appends can arrive from ObjectRef.__del__
+    while this thread is already inside a flush (GC during pickling).
+    """
+
+    def __init__(self, sink, lock=None):
+        self._sink = sink  # called with the drained entry list, lock held
+        self.lock = lock if lock is not None else threading.RLock()
+        self._entries = []
+        self._bytes = 0
+        self._urgent = False
+        self._closed = False
+        self._in_sink = False
+        self._wake = threading.Event()
+        self._thread = None
+
+    def append(self, entry, nbytes=0):
+        with self.lock:
+            self._entries.append(entry)
+            self._bytes += nbytes
+            if self._closed:
+                # post-close stragglers (interpreter teardown): best effort,
+                # but never from inside an active sink — a nested send would
+                # interleave with the partially written frame
+                if not self._in_sink:
+                    self.flush_locked()
+                return
+            if (len(self._entries) >= _FLUSH_MAX_ENTRIES
+                    or self._bytes >= _FLUSH_MAX_BYTES):
+                self._urgent = True
+            if self._thread is None:
+                t = threading.Thread(
+                    target=self._timer_loop, daemon=True,
+                    name="ray-tpu-delta-flusher")
+                try:
+                    t.start()
+                    self._thread = t
+                except RuntimeError:
+                    # interpreter teardown: no new threads — sink directly
+                    if not self._in_sink:
+                        self.flush_locked()
+                    return
+        self._wake.set()
+
+    def drain_locked(self):
+        """Take the pending entries without sinking them (the caller ships
+        them itself, e.g. fused with a pipelined submit). Lock must be held."""
+        entries, self._entries, self._bytes = self._entries, [], 0
+        return entries
+
+    def flush_locked(self):
+        if self._entries:
+            entries = self.drain_locked()
+            self._in_sink = True
+            try:
+                self._sink(entries)
+            finally:
+                self._in_sink = False
+
+    def flush(self):
+        with self.lock:
+            self.flush_locked()
+
+    def close(self):
+        with self.lock:
+            self._closed = True
+            self.flush_locked()
+        self._wake.set()
+
+    def _timer_loop(self):
+        while True:
+            self._wake.wait()
+            if self._closed:
+                return
+            if not self._urgent:
+                time.sleep(_FLUSH_INTERVAL_S)
+            if self._closed:
+                return
+            with self.lock:
+                self._wake.clear()
+                self._urgent = False
+                self.flush_locked()
 
 
 class BaseClient:
@@ -73,8 +189,27 @@ class DriverClient(BaseClient):
         self.store = controller.store
         self.job_id = controller.job_id
         self.is_driver = True
+        self._pipelined = not _sync_submit_requested()
+        self._flusher = _DeltaFlusher(self._post_batch)
+
+    def _post_batch(self, entries):
+        """Flusher sink: apply a drained batch on the controller loop. Loop
+        callbacks run in post order, so posting under the flusher lock keeps
+        batches ordered among themselves and ahead of any later bridge call."""
+        try:
+            self.loop.call_soon_threadsafe(
+                self.controller.apply_batch_local, entries)
+        except RuntimeError:
+            pass  # loop already closed at shutdown
+
+    def flush(self):
+        """Post any pending deltas to the controller loop (api.shutdown calls
+        this before stopping the controller so nothing is silently dropped)."""
+        self._flusher.flush()
 
     def _call(self, coro, timeout=None):
+        self._flusher.flush()  # pending deltas apply before `coro` runs
+        protocol.note_roundtrip("driver_call")
         fut = asyncio.run_coroutine_threadsafe(coro, self.loop)
         try:
             return fut.result(timeout)
@@ -84,6 +219,8 @@ class DriverClient(BaseClient):
 
     def _call_soon(self, fn, *args):
         """Run fn on the controller loop and wait (thread-safe sync bridge)."""
+        self._flusher.flush()
+        protocol.note_roundtrip("driver_call")
         done = concurrent.futures.Future()
 
         def run():
@@ -97,7 +234,27 @@ class DriverClient(BaseClient):
 
     # -- api surface --------------------------------------------------------
     def submit(self, spec: TaskSpec):
-        return self._call(self.controller.submit(spec))
+        if not self._pipelined:
+            return self._call(self.controller.submit(spec))
+        n = (1 if spec.num_returns == "streaming"
+             else max(spec.num_returns, 1))
+        oids = [ids.object_id_for_return(spec.task_id, i) for i in range(n)]
+        ctl = self.controller
+        with self._flusher.lock:
+            # fuse pending deltas with the submit into ONE loop callback:
+            # put registrations for the spec's args apply first, atomically
+            entries = self._flusher.drain_locked()
+
+            def run():
+                if entries:
+                    ctl.apply_batch_local(entries)
+                ctl.submit_pipelined(spec, oids)
+
+            try:
+                self.loop.call_soon_threadsafe(run)
+            except RuntimeError:
+                pass  # loop closed at shutdown: the refs are already dead
+        return oids
 
     def get(self, oids, timeout=None):
         descs = self._call(self.controller.get_descriptors(oids, timeout),
@@ -111,8 +268,12 @@ class DriverClient(BaseClient):
         return oid
 
     def _register_put(self, oid, meta_len, size, inline, contained):
-        self._call_soon(self.controller.register_put, oid, meta_len, size,
-                        inline, contained)
+        if not self._pipelined:
+            self._call_soon(self.controller.register_put, oid, meta_len,
+                            size, inline, contained)
+            return
+        self._flusher.append(("put", oid, meta_len, size, inline, contained),
+                             nbytes=len(inline) if inline is not None else 0)
 
     def wait(self, oids, num_returns, timeout):
         return self._call(self.controller.wait(oids, num_returns, timeout))
@@ -129,41 +290,25 @@ class DriverClient(BaseClient):
     def register_actor(self, spec, options):
         return self._call_soon(self.controller.register_actor, spec, options)
 
+    # deltas ride the flusher (the sink swallows loop-closed RuntimeError at
+    # shutdown, like the old direct call_soon_threadsafe wrappers did)
     def decref(self, oid):
-        try:
-            self.loop.call_soon_threadsafe(self.controller.decref, [oid])
-        except RuntimeError:
-            pass  # loop already closed at shutdown
+        self._flusher.append(("decref", oid))
 
     def incref(self, oid):
-        try:
-            self.loop.call_soon_threadsafe(self.controller.incref, [oid])
-        except RuntimeError:
-            pass
+        self._flusher.append(("incref", oid))
 
     def actor_incref(self, actor_id):
-        try:
-            self.loop.call_soon_threadsafe(self.controller.actor_incref, actor_id)
-        except RuntimeError:
-            pass
+        self._flusher.append(("actor_incref", actor_id))
 
     def actor_decref(self, actor_id):
-        try:
-            self.loop.call_soon_threadsafe(self.controller.actor_decref, actor_id)
-        except RuntimeError:
-            pass  # loop already closed at shutdown
+        self._flusher.append(("actor_decref", actor_id))
 
     def open_stream(self, task_id):
-        try:
-            self.loop.call_soon_threadsafe(self.controller.open_stream, task_id)
-        except RuntimeError:
-            pass
+        self._flusher.append(("open_stream", task_id))
 
     def close_stream(self, task_id):
-        try:
-            self.loop.call_soon_threadsafe(self.controller.close_stream, task_id)
-        except RuntimeError:
-            pass
+        self._flusher.append(("close_stream", task_id))
 
     def resources(self):
         return (self._call_soon(self.controller.res_total),
@@ -201,6 +346,7 @@ class DriverClient(BaseClient):
         self._call_soon(self.controller.remove_placement_group, pg_id)
 
     def as_future(self, ref):
+        self._flusher.flush()  # the ref's put may still be in the batch
         out = concurrent.futures.Future()
 
         def done(descs_fut):
@@ -242,7 +388,12 @@ class WorkerClient(BaseClient):
         self.sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         self.sock.connect(socket_path)
         self.is_driver = driver
-        self._lock = threading.Lock()
+        # RLock: ObjectRef.__del__ can fire mid-send (GC during pickling) and
+        # re-enter via the flusher, which shares this lock so every socket
+        # write — batch frames included — stays serialized and ordered
+        self._lock = threading.RLock()
+        self._pipelined = not _sync_submit_requested()
+        self._flusher = _DeltaFlusher(self._send_batch, self._lock)
         self._reqs = {}
         self._req_counter = 0
         self.task_queue = []  # consumed by worker_main
@@ -319,22 +470,47 @@ class WorkerClient(BaseClient):
                 import os
                 os._exit(0)
 
+    def _send_batch(self, entries):
+        """Flusher sink (lock held): one multi-entry frame for the batch."""
+        try:
+            protocol.send_msg(self.sock, "batch", entries=entries)
+        except OSError:
+            pass  # controller gone: its crash reconciliation covers the rest
+
+    def flush(self):
+        self._flusher.flush()
+
+    def close(self):
+        self._flusher.close()
+        super().close()
+
     def _rpc(self, kind, timeout=None, **payload):
         with self._lock:
+            self._flusher.flush_locked()  # forced flush before any blocking RPC
             self._req_counter += 1
             req_id = self._req_counter
             fut = concurrent.futures.Future()
             self._reqs[req_id] = fut
             protocol.send_msg(self.sock, kind, req_id=req_id, **payload)
+        protocol.note_roundtrip(kind)
         return fut.result(timeout)
 
     def _send(self, kind, **payload):
         with self._lock:
+            self._flusher.flush_locked()  # frames apply in issue order
             protocol.send_msg(self.sock, kind, **payload)
 
     # -- api surface --------------------------------------------------------
     def submit(self, spec: TaskSpec):
-        return self._rpc("submit", spec=spec)["refs"]
+        if not self._pipelined:
+            return self._rpc("submit", spec=spec)["refs"]
+        n = (1 if spec.num_returns == "streaming"
+             else max(spec.num_returns, 1))
+        oids = [ids.object_id_for_return(spec.task_id, i) for i in range(n)]
+        # fire-and-forget; _send flushes first, so the spec can never
+        # overtake the put registrations of its own arguments
+        self._send("submit_async", spec=spec, result_oids=oids)
+        return oids
 
     def get(self, oids, timeout=None):
         # release our cpu while blocked so the pool can progress (ref: raylet
@@ -356,8 +532,12 @@ class WorkerClient(BaseClient):
         return oid
 
     def _register_put(self, oid, meta_len, size, inline, contained):
-        self._rpc("put", oid=oid, meta_len=meta_len, size=size, inline=inline,
-                  contained=contained)
+        if not self._pipelined:
+            self._rpc("put", oid=oid, meta_len=meta_len, size=size,
+                      inline=inline, contained=contained)
+            return
+        self._flusher.append(("put", oid, meta_len, size, inline, contained),
+                             nbytes=len(inline) if inline is not None else 0)
 
     def put_result(self, oid, value):
         """Store a task result; returns (oid, meta_len, size, inline, contained)."""
@@ -388,41 +568,25 @@ class WorkerClient(BaseClient):
         # worker-side actor creation goes through submit path with options piggybacked
         return self._rpc("register_actor_rpc", spec=spec, options=options)["actor_id"]
 
+    # deltas ride the flusher (append cannot fail; the sink swallows OSError
+    # at shutdown, like the old per-message try/except did)
     def decref(self, oid):
-        try:
-            self._send("decref", oids=[oid])
-        except OSError:
-            pass
+        self._flusher.append(("decref", oid))
 
     def incref(self, oid):
-        try:
-            self._send("incref", oids=[oid])
-        except OSError:
-            pass
+        self._flusher.append(("incref", oid))
 
     def actor_incref(self, actor_id):
-        try:
-            self._send("actor_incref", actor_id=actor_id)
-        except OSError:
-            pass
+        self._flusher.append(("actor_incref", actor_id))
 
     def actor_decref(self, actor_id):
-        try:
-            self._send("actor_decref", actor_id=actor_id)
-        except OSError:
-            pass
+        self._flusher.append(("actor_decref", actor_id))
 
     def open_stream(self, task_id):
-        try:
-            self._send("open_stream", task_id=task_id)
-        except OSError:
-            pass
+        self._flusher.append(("open_stream", task_id))
 
     def close_stream(self, task_id):
-        try:
-            self._send("close_stream", task_id=task_id)
-        except OSError:
-            pass
+        self._flusher.append(("close_stream", task_id))
 
     def resources(self):
         p = self._rpc("resources")

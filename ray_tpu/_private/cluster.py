@@ -615,7 +615,8 @@ class ClusterServer:
         try:
             for d in p.get("deps") or []:
                 self.c._ingest_bytes(d["oid"], d)
-            oids = await self.c.submit(p["spec"])
+            oids = await self.c.submit(p["spec"],
+                                       result_oids=p.get("result_oids"))
             self._node_reply(node, p["req_id"], refs=oids)
         except Exception as e:  # noqa: BLE001
             self._node_reply(node, p["req_id"], error=e)

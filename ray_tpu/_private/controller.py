@@ -540,6 +540,12 @@ class Controller:
         elif kind == "submit":
             oids = await self.submit(p["spec"])
             self._reply(w, p["req_id"], refs=oids)
+        elif kind == "submit_async":
+            # pipelined path: the client derived result_oids itself and is
+            # not waiting for a reply; errors land in the refs' descriptors
+            self.submit_pipelined(p["spec"], p["result_oids"])
+        elif kind == "batch":
+            self._apply_batch(w, p["entries"])
         elif kind == "get":
             self.loop.create_task(self._worker_get(w, p))
         elif kind == "wait":
@@ -554,20 +560,10 @@ class Controller:
             self._on_unblocked(w, p["task_id"])
         elif kind == "decref":
             for oid in p["oids"]:
-                if oid.startswith("actor-"):
-                    self._worker_actor_decref(w, oid)
-                elif oid.startswith("task-"):
-                    self._worker_close_stream(w, oid)
-                else:
-                    self.decref([oid])
+                self._worker_decref_one(w, oid)
         elif kind == "incref":
             for oid in p["oids"]:
-                if oid.startswith("actor-"):
-                    self._worker_actor_incref(w, oid)
-                elif oid.startswith("task-"):
-                    self._worker_open_stream(w, oid)
-                else:
-                    self.incref([oid])
+                self._worker_incref_one(w, oid)
         elif kind == "actor_incref":
             self._worker_actor_incref(w, p["actor_id"])
         elif kind == "actor_decref":
@@ -640,6 +636,70 @@ class Controller:
     def _reply(self, w: WorkerConn, req_id, **payload):
         protocol.awrite_msg(w.writer, "resp", req_id=req_id, **payload)
 
+    # --------------------------------------------- coalesced client batches
+    # Entry format (client._DeltaFlusher): ("put", oid, meta_len, size,
+    # inline, contained) | ("incref"|"decref"|"actor_incref"|"actor_decref"|
+    # "open_stream"|"close_stream", id). Entries apply STRICTLY in append
+    # order — the client's only ordering obligation is that it flushes before
+    # any other frame on the same channel, so a decref can never be applied
+    # before the put that created its ref.
+
+    def _worker_incref_one(self, w: WorkerConn, oid: str):
+        # contained-id lists carry actor handles and generator task-ids too
+        # (prefix dispatch); worker-held refs are tallied for crash release
+        if oid.startswith("actor-"):
+            self._worker_actor_incref(w, oid)
+        elif oid.startswith("task-"):
+            self._worker_open_stream(w, oid)
+        else:
+            self.incref([oid])
+
+    def _worker_decref_one(self, w: WorkerConn, oid: str):
+        if oid.startswith("actor-"):
+            self._worker_actor_decref(w, oid)
+        elif oid.startswith("task-"):
+            self._worker_close_stream(w, oid)
+        else:
+            self.decref([oid])
+
+    def _apply_batch(self, w: WorkerConn, entries):
+        for e in entries:
+            op = e[0]
+            if op == "put":
+                self.register_put(e[1], e[2], e[3], e[4], e[5])
+            elif op == "incref":
+                self._worker_incref_one(w, e[1])
+            elif op == "decref":
+                self._worker_decref_one(w, e[1])
+            elif op == "actor_incref":
+                self._worker_actor_incref(w, e[1])
+            elif op == "actor_decref":
+                self._worker_actor_decref(w, e[1])
+            elif op == "open_stream":
+                self._worker_open_stream(w, e[1])
+            elif op == "close_stream":
+                self._worker_close_stream(w, e[1])
+
+    def apply_batch_local(self, entries):
+        """Driver-side batch: same entries, no per-worker tally (driver refs
+        die with the session, exactly like the former direct calls)."""
+        for e in entries:
+            op = e[0]
+            if op == "put":
+                self.register_put(e[1], e[2], e[3], e[4], e[5])
+            elif op == "incref":
+                self.incref([e[1]])
+            elif op == "decref":
+                self.decref([e[1]])
+            elif op == "actor_incref":
+                self.actor_incref(e[1])
+            elif op == "actor_decref":
+                self.actor_decref(e[1])
+            elif op == "open_stream":
+                self.open_stream(e[1])
+            elif op == "close_stream":
+                self.close_stream(e[1])
+
     async def _worker_get(self, w, p):
         try:
             results = await self.get_descriptors(p["oids"], p.get("timeout"))
@@ -672,9 +732,66 @@ class Controller:
     # ------------------------------------------------------------- submission
     async def submit(self, spec: TaskSpec,
                      result_oids: List[str] = None) -> List[str]:
+        """Async façade over `_submit_sync` for the legacy blocking submit
+        RPC and cluster-head forwarding."""
+        return self._submit_sync(spec, result_oids)
+
+    def submit_pipelined(self, spec: TaskSpec, result_oids: List[str]):
+        """Fire-and-forget submission with CLIENT-derived result ids (ref:
+        ObjectID::ForTaskReturn): the client already handed out ObjectRefs
+        for `result_oids`, so any submission error must surface through the
+        refs' descriptors — never raise back to the transport."""
+        if type(self).submit is Controller.submit:
+            try:
+                self._submit_sync(spec, result_oids)
+            except BaseException as err:  # noqa: BLE001 - into descriptors
+                self._fail_submit(spec, result_oids, err)
+            return
+        # subclassed submit (node-agent up-spill) awaits internally: run it
+        # as a loop task — created here, so FIFO task scheduling still puts
+        # its first step (which sends any uplink frame) ahead of the handling
+        # of later frames from the same worker
+        task = self.loop.create_task(self.submit(spec, result_oids))
+
+        def _done(t):
+            if not t.cancelled() and t.exception() is not None:
+                self._fail_submit(spec, result_oids, t.exception())
+
+        task.add_done_callback(_done)
+
+    def _fail_submit(self, spec: TaskSpec, result_oids: List[str], err):
+        if not isinstance(err, Exception):  # KeyboardInterrupt etc.
+            err = RuntimeError(f"submit failed: {err!r}")
+        rec = self.tasks.get(spec.task_id)
+        if rec is not None:
+            self._fail_task(rec, err)
+            return
+        # submit died before the TaskRecord existed: error the result
+        # objects directly so pending gets raise instead of hanging
+        for oid in result_oids:
+            meta = self.objects.get(oid)
+            if meta is None:
+                meta = ObjectMeta(object_id=oid, creating_task=spec.task_id)
+                self.objects[oid] = meta
+                self.object_events[oid] = asyncio.Event()
+            meta.error = err
+            meta.location = "error"
+            self.object_events[oid].set()
+            self._resolve_dep(oid)
+        st = self.streams.get(spec.task_id)
+        if st is not None:
+            st.error = err
+            st.finished = True
+            st.cond.set()
+
+    def _submit_sync(self, spec: TaskSpec,
+                     result_oids: List[str] = None) -> List[str]:
         """Register a task; returns result object ids immediately (futures).
         `result_oids` preallocates the ids — used when a cluster head
-        forwards a task here, so both controllers name the same objects."""
+        forwards a task here (so both controllers name the same objects) and
+        by pipelined clients that derived the ids themselves. Deliberately
+        synchronous: it must run to completion in one loop step so a
+        fire-and-forget submit is fully applied before any later frame."""
         if spec.num_returns == "streaming":
             result_oids = result_oids or [ids.object_id()]  # generator handle
             self.streams[spec.task_id] = StreamState()

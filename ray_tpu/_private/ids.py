@@ -24,6 +24,18 @@ def object_id() -> str:
     return new_id("obj")
 
 
+def object_id_for_return(task_id: str, index: int) -> str:
+    """Deterministic id of a task's index-th return object (reference:
+    ObjectID::ForTaskReturn, src/ray/common/id.h).
+
+    Clients derive return refs from the task id alone, so submit can hand
+    back ObjectRefs and ship the spec fire-and-forget; the controller derives
+    the same ids when the spec arrives. Keeps the "obj-" prefix that refcount
+    and cancel paths dispatch on.
+    """
+    return f"obj-{task_id}-ret{index}"
+
+
 def actor_id() -> str:
     return new_id("actor")
 

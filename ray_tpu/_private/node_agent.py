@@ -128,7 +128,9 @@ class NodeController(Controller):
 
     async def submit(self, spec: TaskSpec, result_oids=None):
         if self._spills_up(spec):
-            return await self.agent.up_submit(spec)
+            # pipelined clients already derived the result ids: the head must
+            # name the same objects (mirrors forward_task's preallocation)
+            return await self.agent.up_submit(spec, result_oids)
         oids = await super().submit(spec, result_oids=result_oids)
         rec = self.tasks.get(spec.task_id)
         if rec is not None and self.agent is not None:
@@ -629,9 +631,11 @@ class NodeAgent:
         self.c._ingest_bytes(oid, p)
         return True
 
-    async def up_submit(self, spec: TaskSpec):
+    async def up_submit(self, spec: TaskSpec, result_oids=None):
         """Submit at the head for cluster-wide placement. Ships bytes for
-        any ref args this node holds locally (the head may not have them)."""
+        any ref args this node holds locally (the head may not have them).
+        `result_oids` carries client-derived return ids up, so a pipelined
+        submit names the same objects at the head."""
         deps = []
         oids = [v for kind, v in
                 list(spec.args) + list(spec.kwargs.values()) if kind == "ref"]
@@ -652,7 +656,8 @@ class NodeAgent:
                 deps.append({"oid": oid, "enc": "blob", "data": blob,
                              "size": meta.size, "meta_len": meta.meta_len,
                              "contained": list(meta.contained)})
-        p = await self._rpc("up_submit", spec=spec, deps=deps)
+        p = await self._rpc("up_submit", spec=spec, deps=deps,
+                            result_oids=result_oids)
         if "error" in p:
             raise p["error"]
         # the result objects live at the head (or wherever it places the

@@ -5,6 +5,14 @@ process's runtime client (driver or worker). Only the original driver-side ref
 participates in refcounting (`_owned`); refs reconstructed in workers are
 borrows, matching the reference's owner/borrower split
 (src/ray/core_worker/reference_count.h) collapsed to the single-owner case.
+
+Refs returned by `.remote()` carry CLIENT-derived ids
+(ids.object_id_for_return) — submit is fire-and-forget and this ref exists
+before the controller has seen the task. The incref/decref calls below are
+coalesced by the client's delta flusher into batched frames; the flusher's
+flush-before-anything-blocking rule keeps them ordered after the put/submit
+that created the id, so a __del__-driven decref can never evict an object a
+later-issued operation still expects (see client._DeltaFlusher).
 """
 
 

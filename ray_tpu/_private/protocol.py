@@ -6,16 +6,64 @@ zero codegen; the message *vocabulary* mirrors the reference's core-worker ↔
 raylet ↔ GCS RPCs (SubmitTask, PushTask reply, WaitForObjectEviction, ...).
 
 Frame: u32 little-endian length | pickle payload. Messages are (kind, dict).
+
+Pipelined control plane additions:
+- the "batch" kind carries a list of coalesced refcount/put entries (see
+  client._DeltaFlusher / controller._apply_batch); it is an ordinary frame,
+  no wire-format change.
+- per-process counters tally frames by kind and blocking round trips, read
+  through ray_tpu.util.metrics.control_plane_counters(); benchmarks and the
+  pipelining tests assert on deltas of these.
 """
 
 import pickle
 import struct
+import threading
+from typing import Dict
 
 _HDR = struct.Struct("<I")
+
+# -- control-plane transport counters (per process) -------------------------
+# Plain dicts under one lock rather than util.metrics Counters: protocol.py
+# is imported while ray_tpu/__init__ is still executing, so it must not pull
+# in ray_tpu.util. util/metrics.py re-exposes these lazily.
+_counts_lock = threading.Lock()
+FRAMES_SENT: Dict[str, int] = {}
+FRAMES_RECEIVED: Dict[str, int] = {}
+ROUNDTRIPS: Dict[str, int] = {}
+
+
+def _bump(table: Dict[str, int], kind: str) -> None:
+    with _counts_lock:
+        table[kind] = table.get(kind, 0) + 1
+
+
+def note_roundtrip(kind: str) -> None:
+    """Record one blocking control round trip (a request that waited for its
+    reply — worker `_rpc` or a driver bridge call into the controller loop)."""
+    _bump(ROUNDTRIPS, kind)
+
+
+def roundtrips_total() -> int:
+    with _counts_lock:
+        return sum(ROUNDTRIPS.values())
+
+
+def frames_sent_total() -> int:
+    with _counts_lock:
+        return sum(FRAMES_SENT.values())
+
+
+def counter_snapshot() -> Dict[str, Dict[str, int]]:
+    with _counts_lock:
+        return {"frames_sent": dict(FRAMES_SENT),
+                "frames_received": dict(FRAMES_RECEIVED),
+                "roundtrips": dict(ROUNDTRIPS)}
 
 
 def send_msg(sock, kind: str, **payload):
     data = pickle.dumps((kind, payload), protocol=5)
+    _bump(FRAMES_SENT, kind)
     sock.sendall(_HDR.pack(len(data)) + data)
 
 
@@ -27,32 +75,43 @@ def recv_msg(sock):
     data = _recv_exact(sock, n)
     if data is None:
         return None
-    return pickle.loads(data)
+    msg = pickle.loads(data)
+    _bump(FRAMES_RECEIVED, msg[0])
+    return msg
 
 
 def _recv_exact(sock, n):
-    chunks = []
-    while n:
-        chunk = sock.recv(min(n, 1 << 20))
-        if not chunk:
+    # recv_into a preallocated buffer: the old recv()+join built every chunk
+    # as a fresh bytes object (two passes over large frames and O(chunks)
+    # allocations); this is one allocation and one copy total.
+    buf = bytearray(n)
+    view = memoryview(buf)
+    pos = 0
+    while pos < n:
+        got = sock.recv_into(view[pos:])
+        if not got:
             return None
-        chunks.append(chunk)
-        n -= len(chunk)
-    return b"".join(chunks)
+        pos += got
+    return buf
 
 
 # -- asyncio side (controller) ---------------------------------------------
 
 async def aread_msg(reader):
+    # readexactly already buffers into one preallocated bytearray internally
+    # (asyncio.StreamReader), so no recv_into analog is needed here.
     try:
         hdr = await reader.readexactly(4)
         (n,) = _HDR.unpack(hdr)
         data = await reader.readexactly(n)
     except (EOFError, ConnectionResetError, BrokenPipeError, OSError):
         return None
-    return pickle.loads(data)
+    msg = pickle.loads(data)
+    _bump(FRAMES_RECEIVED, msg[0])
+    return msg
 
 
 def awrite_msg(writer, kind: str, **payload):
     data = pickle.dumps((kind, payload), protocol=5)
+    _bump(FRAMES_SENT, kind)
     writer.write(_HDR.pack(len(data)) + data)

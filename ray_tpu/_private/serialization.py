@@ -12,7 +12,6 @@ device_put on the consumer side (the host→HBM hop is the one unavoidable copy
 on TPU).
 """
 
-import io
 import pickle
 import struct
 import threading
@@ -86,13 +85,18 @@ def dumps_with_refs(obj):
     return blob, list(contained)
 
 
-def pack_parts(meta: bytes, buffers) -> bytes:
-    out = io.BytesIO()
-    out.write(struct.pack("<I", len(meta)))
-    out.write(meta)
+def pack_parts(meta: bytes, buffers) -> bytearray:
+    # Sized once and written in place: BytesIO + getvalue() grew the internal
+    # buffer and then copied the whole blob a second time.
+    out = bytearray(4 + len(meta) + sum(b.nbytes for b in buffers))
+    struct.pack_into("<I", out, 0, len(meta))
+    pos = 4
+    out[pos : pos + len(meta)] = meta
+    pos += len(meta)
     for b in buffers:
-        out.write(b)
-    return out.getvalue()
+        out[pos : pos + b.nbytes] = b
+        pos += b.nbytes
+    return out
 
 
 def unpack(data) -> object:

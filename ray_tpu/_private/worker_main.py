@@ -209,6 +209,10 @@ def main():
         if p is None:
             break
         pool.submit(_execute, ws, p)
+    pool.shutdown(wait=True)
+    # drain any still-buffered refcount deltas before dropping the socket
+    # (best effort: if the controller is already gone the flush is a no-op)
+    client.close()
 
 
 if __name__ == "__main__":

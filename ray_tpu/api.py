@@ -166,6 +166,13 @@ def shutdown():
             state.set_global_client(None)
             return
         try:
+            # drain batched refcount/put deltas first: pending decrefs apply
+            # before the controller audits its object table, so shutdown
+            # never reports refs the driver already dropped
+            rt.client.flush()
+        except Exception:  # noqa: BLE001
+            pass
+        try:
             fut = asyncio.run_coroutine_threadsafe(rt.controller.shutdown(), rt.loop)
             fut.result(10)
         except Exception:  # noqa: BLE001 - teardown best-effort

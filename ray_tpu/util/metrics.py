@@ -142,3 +142,30 @@ def collect() -> List[Dict]:
 def clear_registry():
     with _registry_lock:
         _registry.clear()
+
+
+# -- control-plane transport counters ---------------------------------------
+# The raw tallies live in _private/protocol.py (imported during
+# ray_tpu/__init__, so it cannot depend on this package); these helpers are
+# the public read surface. Benchmarks and the pipelining tests assert on
+# DELTAS of these — e.g. pipelined submit must cost ≤ 1 blocking round trip
+# per N submitted tasks.
+
+def control_plane_counters() -> Dict[str, Dict[str, int]]:
+    """Per-process frame/round-trip tallies by message kind:
+    {"frames_sent": {kind: n}, "frames_received": {...}, "roundtrips": {...}}.
+    Frames count unix-socket messages; round trips count blocking control
+    calls (worker RPCs that awaited a reply, driver bridge calls into the
+    controller loop)."""
+    from ray_tpu._private import protocol
+    return protocol.counter_snapshot()
+
+
+def control_roundtrips_total() -> int:
+    from ray_tpu._private import protocol
+    return protocol.roundtrips_total()
+
+
+def control_frames_sent_total() -> int:
+    from ray_tpu._private import protocol
+    return protocol.frames_sent_total()

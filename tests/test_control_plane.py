@@ -1,0 +1,249 @@
+"""Pipelined control plane (PR 2): client-derived return ids, fire-and-forget
+submit, batched refcount/put frames.
+
+The contract: batching is TRANSPARENT. Every blocking control RPC flushes
+buffered deltas first, so a decref can never overtake the put/submit that
+created the id — and pipelined submit costs ≤ 1 blocking controller round
+trip per N tasks (the perf claim benchmarked by benchmarks/core_bench.py).
+"""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _client():
+    from ray_tpu._private import state
+    return state.global_client()
+
+
+def _controller():
+    return _client().controller
+
+
+def _wait_for(cond, timeout=15.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.05)
+    return cond()
+
+
+# ------------------------------------------------------------- pipelining
+
+def test_pipelined_submit_single_roundtrip(ray_session):
+    """50 driver-side submits must not block on the controller: the specs go
+    fire-and-forget, so the round-trip counter moves ≤ 1 across the loop."""
+    ray = ray_session
+    from ray_tpu.util import metrics
+
+    @ray.remote
+    def f(i):
+        return i * 2
+
+    ray.get(f.remote(0))  # warm the worker pool outside the counted window
+    rt0 = metrics.control_roundtrips_total()
+    refs = [f.remote(i) for i in range(50)]
+    submit_rt = metrics.control_roundtrips_total() - rt0
+    assert submit_rt <= 1, f"50 pipelined submits cost {submit_rt} round trips"
+    assert ray.get(refs, timeout=60) == [i * 2 for i in range(50)]
+
+
+def test_return_ids_are_client_derived(ray_session):
+    """Refs exist before the controller has seen the spec, named by
+    ids.object_id_for_return(task_id, index)."""
+    ray = ray_session
+
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    refs = three.remote()
+    assert [r.id.rsplit("-", 1)[1] for r in refs] == ["ret0", "ret1", "ret2"]
+    task_id = refs[0].id[len("obj-"):-len("-ret0")]
+    from ray_tpu._private import ids
+    assert [r.id for r in refs] == [
+        ids.object_id_for_return(task_id, i) for i in range(3)]
+    assert ray.get(refs, timeout=60) == [1, 2, 3]
+
+
+def test_submit_error_surfaces_through_ref(ray_session):
+    """Fire-and-forget submit has no reply to carry a validation error; it
+    must land in the ref's descriptor and raise from get()."""
+    import pytest
+    ray = ray_session
+
+    @ray.remote(num_cpus=10_000)
+    def impossible():
+        return 1
+
+    ref = impossible.remote()
+    with pytest.raises(ValueError):
+        ray.get(ref, timeout=30)
+
+
+def test_worker_fanout_single_roundtrip(ray_session):
+    """WorkerClient.submit is fire-and-forget over the unix socket too."""
+    ray = ray_session
+
+    @ray.remote
+    def fanout(m):
+        import ray_tpu
+        from ray_tpu.util import metrics
+
+        @ray_tpu.remote
+        def child(i):
+            return i + 100
+
+        rt0 = metrics.control_roundtrips_total()
+        refs = [child.remote(i) for i in range(m)]
+        submit_rt = metrics.control_roundtrips_total() - rt0
+        return submit_rt, ray_tpu.get(refs)
+
+    submit_rt, vals = ray.get(fanout.remote(20), timeout=60)
+    assert submit_rt <= 1, f"20 worker submits cost {submit_rt} round trips"
+    assert vals == [i + 100 for i in range(20)]
+
+
+# ------------------------------------------------- refcount batch ordering
+
+def test_put_then_immediate_del_as_task_arg(ray_session):
+    """put → pass ref as task arg → drop the local ref at once. The decref
+    rides a batch BEHIND the put registration and the submit, and the
+    task's arg pin keeps the object alive until it runs."""
+    ray = ray_session
+
+    @ray.remote
+    def total(a):
+        return int(a.sum())
+
+    arr = np.arange(64 * 1024, dtype=np.int64)  # shm-sized, not inline
+    want = int(arr.sum())
+    ref = ray.put(arr)
+    fut = total.remote(ref)
+    del ref
+    gc.collect()
+    assert ray.get(fut, timeout=60) == want
+
+
+def test_put_and_decref_same_batch_applies_in_order(ray_session):
+    """A put and its decref-to-zero coalesced into one flush must apply
+    in order: register first, then evict — never a dangling decref."""
+    ray = ray_session
+    ctl = _controller()
+    ref = ray.put(b"x" * 128)
+    oid = ref.id
+    del ref
+    gc.collect()
+    _client().flush()
+    assert _wait_for(lambda: oid not in ctl.objects), \
+        "decref-to-zero must evict once the batch lands"
+
+
+def test_incref_racing_timer_flush(ray_session):
+    """Explicit increfs split across timer flushes still net out exactly:
+    the object survives while any balance remains, and eviction happens
+    only after the final decref lands."""
+    ray = ray_session
+    ctl = _controller()
+    client = _client()
+    ref = ray.put(b"y" * 256)
+    oid = ref.id
+    for _ in range(3):
+        client.incref(oid)
+    time.sleep(0.05)  # > flush interval: the timer fires mid-sequence
+    for _ in range(3):
+        client.decref(oid)
+    client.flush()
+    time.sleep(0.05)
+    assert oid in ctl.objects, "balanced incref/decref must not evict"
+    assert ray.get(ref, timeout=30) == b"y" * 256
+    del ref
+    gc.collect()
+    client.flush()
+    assert _wait_for(lambda: oid not in ctl.objects)
+
+
+def test_contained_ref_survives_inner_del(ray_session):
+    """An inner ref serialized into an outer put stays reachable through the
+    outer object even when the local inner handle drops — containment
+    pinning must order correctly through the batched frames."""
+    ray = ray_session
+    inner = ray.put(np.full(2048, 7, dtype=np.int32))
+    outer = ray.put({"nested": inner})
+    del inner
+    gc.collect()
+    _client().flush()
+    time.sleep(0.05)
+    got = ray.get(ray.get(outer, timeout=30)["nested"], timeout=30)
+    assert int(got.sum()) == 7 * 2048
+
+
+def test_shutdown_flushes_pending_deltas():
+    """Driver shutdown right after dropping refs: the pending decrefs must
+    drain cleanly before the controller stops (exit 0, no hang)."""
+    script = (
+        "import os; os.environ.setdefault('RAY_TPU_NUM_CHIPS', '0')\n"
+        "import ray_tpu\n"
+        "ray_tpu.init(num_cpus=2)\n"
+        "refs = [ray_tpu.put(bytes([i]) * 512) for i in range(64)]\n"
+        "del refs\n"
+        "import gc; gc.collect()\n"
+        "ray_tpu.shutdown()\n"
+        "print('CLEAN')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run([sys.executable, "-c", script], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "CLEAN" in out.stdout
+
+
+# ------------------------------------------------------- bench smoke hooks
+
+def test_core_bench_smoke():
+    """core_bench --smoke is the tier-1 control-plane invariant check:
+    pipelined submit ≤ 1 round trip per N tasks, driver and worker side."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "benchmarks", "core_bench.py"),
+         "--smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["submit_roundtrips"] <= 1
+    assert rec["fanout"]["submit_rt"] <= 1
+
+
+def test_sync_submit_escape_hatch():
+    """RAY_TPU_SYNC_SUBMIT=1 restores the blocking control plane end to end
+    (the core_bench baseline mode must stay a faithful fallback)."""
+    script = (
+        "import os; os.environ.setdefault('RAY_TPU_NUM_CHIPS', '0')\n"
+        "import ray_tpu\n"
+        "from ray_tpu.util import metrics\n"
+        "@ray_tpu.remote\n"
+        "def f(i): return i\n"
+        "ray_tpu.init(num_cpus=2)\n"
+        "ray_tpu.get(f.remote(0))\n"
+        "rt0 = metrics.control_roundtrips_total()\n"
+        "refs = [f.remote(i) for i in range(10)]\n"
+        "rt = metrics.control_roundtrips_total() - rt0\n"
+        "assert rt >= 10, f'sync mode must block per submit, got {rt}'\n"
+        "assert ray_tpu.get(refs) == list(range(10))\n"
+        "r = ray_tpu.put(b'z' * 100)\n"
+        "assert ray_tpu.get(r) == b'z' * 100\n"
+        "ray_tpu.shutdown()\n"
+        "print('SYNC_OK')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_SYNC_SUBMIT="1")
+    out = subprocess.run([sys.executable, "-c", script], cwd=REPO, env=env,
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr[-2000:]
+    assert "SYNC_OK" in out.stdout
