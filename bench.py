@@ -34,7 +34,8 @@ Attempt ladder: llama_1b (bf16 params, remat) -> llama_125m (f32) -> CPU-scrub
 llama_125m, so the round always records SOME number with rc=0. The final JSON
 line is the merged record:
 {"metric", "value", "unit", "vs_baseline", "mfu", "backend", ...,
- "serving_b8": {...}, "serving_b32": {...}, "rllib_ppo": {...}}.
+ "serving_b8": {...}, "serving_b32": {...}, "rllib_ppo": {...},
+ "core_cp": {...}, "transfer_dp": {...}}.
 vs_baseline compares against the newest prior BENCH_r*.json with the same
 metric name (the reference fork publishes no numbers — BASELINE.json
 "published" is {} — so our own history is the baseline).
@@ -191,7 +192,7 @@ def _kill_stale_workers():
         except (ProcessLookupError, PermissionError):
             pass
     for pat in (r"bench\.py --measure",
-                r"benchmarks/(serving|rllib|decode)_bench\.py"):
+                r"benchmarks/(serving|rllib|decode|transfer)_bench\.py"):
         for pid in _pgrep(pat):
             try:
                 _log(f"bench: killing stray bench child pid={pid} ({pat})")
@@ -595,7 +596,8 @@ def orchestrate():
                 ("serving_b8", "serving_bench.py", 900, {"B": "8"}),
                 ("serving_b32", "serving_bench.py", 900, {"B": "32"}),
                 ("rllib_ppo", "rllib_bench.py", 600, None),
-                ("core_cp", "core_bench.py", 300, None)):
+                ("core_cp", "core_bench.py", 300, None),
+                ("transfer_dp", "transfer_bench.py", 300, None)):
             result[key] = _run_aux_bench(script, tmo, extra)
             # re-emit the merged-so-far record (NOT a bare keyed line): the
             # last complete JSON line on stdout is always a full headline

@@ -257,9 +257,13 @@ class DriverClient(BaseClient):
         return oids
 
     def get(self, oids, timeout=None):
-        descs = self._call(self.controller.get_descriptors(oids, timeout),
+        # dedup before the fetch: a get([r, r, ...]) waits/pulls each unique
+        # object once, then fans the descriptors back out in caller order
+        uniq = list(dict.fromkeys(oids))
+        descs = self._call(self.controller.get_descriptors(uniq, timeout),
                            timeout=None if timeout is None else timeout + 5)
-        return self._materialize(oids, descs)
+        by_oid = dict(zip(uniq, descs))
+        return self._materialize(oids, [by_oid[o] for o in oids])
 
     def put(self, value):
         oid = ids.object_id()
@@ -330,6 +334,14 @@ class DriverClient(BaseClient):
         def read():
             return [self.controller.objects[o].size
                     if o in self.controller.objects else 0 for o in oids]
+        return self._call_soon(read)
+
+    def object_locations(self, oids):
+        """Node id holding each object's bytes (the head's own id for
+        head-local objects, None for pending/unknown) — the data streaming
+        executor tags map tasks with their input block's owner."""
+        def read():
+            return [self.controller._object_location(o) for o in oids]
         return self._call_soon(read)
 
     def state(self, kind):
@@ -519,11 +531,14 @@ class WorkerClient(BaseClient):
         if tid:
             self._send("blocked", task_id=tid)
         try:
-            p = self._rpc("get", oids=oids, timeout=timeout)
+            # dedup: each unique object crosses the wire (and pulls) once
+            uniq = list(dict.fromkeys(oids))
+            p = self._rpc("get", oids=uniq, timeout=timeout)
         finally:
             if tid:
                 self._send("unblocked", task_id=tid)
-        return self._materialize(oids, p["results"])
+        by_oid = dict(zip(uniq, p["results"]))
+        return self._materialize(oids, [by_oid[o] for o in oids])
 
     def put(self, value):
         oid = ids.object_id()
@@ -604,6 +619,9 @@ class WorkerClient(BaseClient):
 
     def object_sizes(self, oids):
         return self._rpc("obj_sizes", oids=oids)["sizes"]
+
+    def object_locations(self, oids):
+        return self._rpc("obj_locations", oids=oids)["locations"]
 
     def state(self, kind):
         return self._rpc("state", which=kind)["rows"]

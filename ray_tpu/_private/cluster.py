@@ -268,6 +268,14 @@ class ClusterServer:
         elif kind == "up_cancel":
             c.cancel(p["task_id"], force=p.get("force", False))
             self._node_reply(node, p["req_id"], ok=True)
+        elif kind == "holds_object":
+            # a node finished a direct pull: record it as an extra source so
+            # later fetches can stripe streams across several holders
+            meta = c.objects.get(p["oid"])
+            if (meta is not None and meta.location.startswith("remote:")
+                    and node.node_id != meta.location.split(":", 1)[1]
+                    and node.node_id not in meta.holders):
+                meta.holders.append(node.node_id)
         elif kind == "actor_dead":
             actor = c.actors.get(p["actor_id"])
             node.actors.discard(p["actor_id"])
@@ -299,6 +307,25 @@ class ClusterServer:
         live = [n for n in self.nodes.values() if n.alive]
         from ..util.scheduling_strategies import NodeAffinitySchedulingStrategy
         if isinstance(strat, NodeAffinitySchedulingStrategy):
+            if getattr(strat, "locality_hint", False):
+                # data-layer owner tag: run WHERE THE BLOCK IS. A merely
+                # busy target still wins — the task queues there (ref: the
+                # locality lease policy; task wait ≪ block transfer, and
+                # the data layer's in-flight caps bound the pileup). Only a
+                # dead or never-feasible target falls back to DEFAULT
+                # (which chases holders itself).
+                target_head = strat.node_id == self.c.node_id
+                node = None if target_head else self.nodes.get(strat.node_id)
+                if target_head or (node is not None and node.alive):
+                    feasible_pool = (self.c.total if target_head
+                                     else node.resources)
+                    if self._fits(spec.resources, feasible_pool):
+                        self._note_locality(
+                            True,
+                            self._locality_bytes(spec).get(
+                                None if target_head else strat.node_id, 0))
+                        return node
+                return self._default_place(spec, live)
             if strat.node_id == self.c.node_id:
                 return None
             node = self.nodes.get(strat.node_id)
@@ -332,12 +359,67 @@ class ClusterServer:
                     free[k] = free.get(k, 0) - v
         return free
 
+    def _locality_bytes(self, spec: TaskSpec):
+        """Bytes of the task's ref args resident per candidate, read from
+        the head's object table (the GCS location registry). Key None = the
+        head itself; extra holders credit every node with a copy."""
+        oids = [v for kind, v in
+                list(spec.args) + list(spec.kwargs.values()) if kind == "ref"]
+        oids += [v for v in spec.nested_refs
+                 if not v.startswith(("actor-", "task-"))]
+        by: Dict[Optional[str], int] = {}
+        for oid in dict.fromkeys(oids):
+            meta = self.c.objects.get(oid)
+            if meta is None or not meta.size:
+                continue
+            loc = meta.location
+            if loc.startswith("remote:"):
+                nid = loc.split(":", 1)[1]
+                by[nid] = by.get(nid, 0) + meta.size
+                for h in meta.holders:
+                    by[h] = by.get(h, 0) + meta.size
+            elif loc in ("shm", "spilled", "inline"):
+                by[None] = by.get(None, 0) + meta.size
+        return by
+
+    def _note_locality(self, hit: bool, nbytes: int):
+        """sched_locality_* tallies; read via
+        util.metrics.sched_locality_counters()."""
+        from ..util import metrics
+        metrics.get_or_create(
+            metrics.Counter,
+            "sched_locality_hits" if hit else "sched_locality_misses").inc()
+        if nbytes:
+            metrics.get_or_create(metrics.Counter,
+                                  "sched_locality_bytes").inc(nbytes)
+
     def _default_place(self, spec: TaskSpec, live: List[NodeConn]):
-        """Local if it fits now; else the least-loaded node where it fits
-        now; else local if EVER feasible locally; else any node where it is
-        feasible (queue there)."""
+        """Locality first: among candidates with free resources, place on
+        the one already holding the most arg bytes (ref: the Ray paper's
+        locality-aware lease policy; scheduling_policy.cc hybrid policy).
+        No locality signal — or no holder with room — falls back to the r5
+        resource policy: local if it fits now; else the least-loaded node
+        where it fits now; else local if EVER feasible locally; else any
+        node where it is feasible (queue there)."""
         res = spec.resources
-        if self._fits(res, self._head_free()):
+        head_fits = self._fits(res, self._head_free())
+        local = self._locality_bytes(spec)
+        if local:
+            options = [(None, None)] if head_fits else []
+            options += [(n.node_id, n) for n in live
+                        if self._fits(res, n.available)]
+            if options:
+                # max() keeps the FIRST best, so ties prefer the head then
+                # registration order — stable with the r5 policy
+                key, node = max(options, key=lambda kv: local.get(kv[0], 0))
+                got = local.get(key, 0)
+                if got > 0:
+                    self._note_locality(got >= max(local.values()), got)
+                    return node
+            # arg bytes exist somewhere, but no candidate holding them had
+            # room (or no candidate at all): locality miss, resource-FIFO
+            self._note_locality(False, 0)
+        if head_fits:
             return None
         fitting = [n for n in live if self._fits(res, n.available)]
         if fitting:
@@ -422,6 +504,22 @@ class ClusterServer:
                             result_oids=rec.result_oids, deps=deps,
                             options=options, seq=node.ship_seq)
 
+    def _holder_addrs(self, meta, exclude: Optional[NodeConn] = None):
+        """Live data-server addresses holding `meta`'s bytes — the
+        authoritative owner first, then registered extra holders. The
+        parallel fetch stripes its streams across all of them."""
+        addrs = []
+        ids = []
+        if meta.location.startswith("remote:"):
+            ids.append(meta.location.split(":", 1)[1])
+        ids.extend(meta.holders)
+        for nid in ids:
+            n = self.nodes.get(nid)
+            if (n is not None and n.alive and n.data_addr
+                    and n is not exclude and n.data_addr not in addrs):
+                addrs.append(n.data_addr)
+        return addrs
+
     async def _collect_deps(self, spec: TaskSpec, node: NodeConn):
         """Bytes for every ref the task needs, except those already on the
         target node. Objects on a THIRD node are handed over as a REDIRECT
@@ -447,6 +545,8 @@ class ClusterServer:
                         and owner is not node):
                     deps.append({"oid": oid, "enc": "redirect",
                                  "addr": owner.data_addr,
+                                 "addrs": self._holder_addrs(meta,
+                                                             exclude=node),
                                  "owner": owner.node_id, "size": meta.size,
                                  "meta_len": meta.meta_len,
                                  "contained": list(meta.contained)})
@@ -524,10 +624,23 @@ class ClusterServer:
     # ------------------------------------------------------- object movement
     async def pull_object(self, oid: str, node_id: str) -> bool:
         """Fetch an object's bytes from the node that has it into the head
-        store. True on success."""
+        store. True on success. Prefers the chunked-parallel data plane
+        (streams recv_into the head store directly); the pickle-staged RPC
+        remains the fallback (and the sync path when parallelism is off)."""
         node = self.nodes.get(node_id)
         if node is None or not node.alive:
             return False
+        from .node_agent import parallel_fetch, use_parallel_transfer
+        meta = self.c.objects.get(oid)
+        if (use_parallel_transfer() and node.data_addr and meta is not None
+                and meta.size and meta.location == f"remote:{node_id}"):
+            payload = await parallel_fetch(
+                self._holder_addrs(meta), oid, meta.size, meta.meta_len,
+                meta.contained, self.c.store)
+            if payload is not None:
+                self.c._ingest_bytes(oid, payload)
+                self.free_object(oid, node_id)
+                return True
         try:
             # the node waits out still-computing objects (locate_object may
             # have found the oid "pending"); give its wait headroom
@@ -544,6 +657,27 @@ class ClusterServer:
         # producing store's copy once nothing there needs it)
         self.free_object(oid, node_id)
         return True
+
+    async def pull_objects(self, oids: List[str], node_id: str) -> set:
+        """Batched pull: ONE round trip fetches a whole get()-list's worth
+        of (small) objects from `node_id`. Returns the oids actually
+        ingested; callers pull stragglers individually."""
+        node = self.nodes.get(node_id)
+        if node is None or not node.alive or not oids:
+            return set()
+        try:
+            p = await asyncio.wait_for(
+                self._rpc(node, "pull_objects", oids=list(oids), timeout=90),
+                timeout=105)
+        except (asyncio.TimeoutError, OSError):
+            return set()
+        pulled = set()
+        for r in p.get("results", ()):
+            if r.get("found"):
+                self.c._ingest_bytes(r["oid"], r)
+                self.free_object(r["oid"], node_id)
+                pulled.add(r["oid"])
+        return pulled
 
     async def search_object(self, oid: str) -> bool:
         """Cluster-wide lookup for an oid the head has never seen (e.g. a
@@ -580,6 +714,7 @@ class ClusterServer:
                     and owner is not node):
                 self._node_reply(node, p["req_id"], found=True,
                                  enc="redirect", addr=owner.data_addr,
+                                 addrs=self._holder_addrs(meta, exclude=node),
                                  owner=owner.node_id, size=meta.size,
                                  meta_len=meta.meta_len,
                                  contained=list(meta.contained))
@@ -715,6 +850,11 @@ class ClusterServer:
                     c._fail_actor(actor, f"node {node.node_id} died",
                                   allow_restart=False)
         node.actors.clear()
+        # drop the dead node from holder lists (fetches would just MISS and
+        # redistribute, but no point handing out known-dead sources)
+        for meta in c.objects.values():
+            if node.node_id in meta.holders:
+                meta.holders.remove(node.node_id)
         # objects whose only copy lived there are lost; lineage reconstructs
         # on next access (meta stays, pull fails, _recover_object re-runs)
         c._schedule()

@@ -572,6 +572,10 @@ class Controller:
             self._reply(w, p["req_id"], sizes=[
                 self.objects[o].size if o in self.objects else 0
                 for o in p["oids"]])
+        elif kind == "obj_locations":
+            self._reply(w, p["req_id"],
+                        locations=[self._object_location(o)
+                                   for o in p["oids"]])
         elif kind == "hello":
             # attach handshake: the session's shm arena + job identity so a
             # process with no inherited env can join (ref: ray.init(address=))
@@ -1608,6 +1612,19 @@ class Controller:
         self.object_events[oid].set()
         self._resolve_dep(oid)
 
+    def _object_location(self, oid: str):
+        """Node id holding the object's bytes (this controller's own id for
+        local copies, None for pending/unknown) — the read behind the
+        clients' object_locations()."""
+        meta = self.objects.get(oid)
+        if meta is None:
+            return None
+        if meta.location.startswith("remote:"):
+            return meta.location.split(":", 1)[1]
+        if meta.location in ("shm", "spilled", "inline"):
+            return self.node_id
+        return None
+
     # ------------------------------------------------- cluster object table
     def _register_remote(self, oid: str, node_id: str, size: int = 0,
                          meta_len: int = 0, contained=None):
@@ -1624,6 +1641,7 @@ class Controller:
         meta.size = size
         meta.meta_len = meta_len
         meta.location = f"remote:{node_id}"
+        meta.holders = []  # fresh authoritative copy: old holders are stale
         self.object_events[oid].set()
         self._resolve_dep(oid)
 
@@ -1644,7 +1662,11 @@ class Controller:
             meta.inline_value = p["data"]
             meta.size = p["size"]
         else:
-            if not self.store.exists(oid):
+            if p["enc"] == "direct":
+                # bytes already landed in the local store: a parallel fetch
+                # recv_into'd them straight into the preallocated segment
+                self.store_used += p["size"]
+            elif not self.store.exists(oid):
                 self.store.put_raw(oid, p["data"])
                 self.store_used += p["size"]
             meta.meta_len = p["meta_len"]
@@ -1742,10 +1764,65 @@ class Controller:
                 await asyncio.wait_for(ev.wait(), remaining)
             except asyncio.TimeoutError:
                 raise exc.GetTimeoutError(f"get() timed out waiting for {oid}") from None
+        self._start_batched_pulls(oids)
         out = []
         for oid in oids:
             out.append(await self._descriptor(oid, deadline))
         return out
+
+    _BULK_PULL_MAX = 1 << 20  # small objects coalesce into one pull RPC
+
+    def _start_batched_pulls(self, oids: List[str]):
+        """Coalesce a get()-list's remote pulls BEFORE the per-oid
+        descriptor pass: small objects grouped per owner node ride ONE
+        pull_objects RPC each (O(nodes) round trips, not O(refs)); large
+        objects start their (chunked-parallel) pulls concurrently instead
+        of serially inside _descriptor."""
+        if self.cluster is None:
+            return
+        by_node: Dict[str, List[str]] = {}
+        for oid in dict.fromkeys(oids):
+            meta = self.objects.get(oid)
+            if (meta is None or oid in self._pulls
+                    or not meta.location.startswith("remote:")):
+                continue
+            by_node.setdefault(meta.location.split(":", 1)[1], []).append(oid)
+        for node_id, group in by_node.items():
+            bulk = [o for o in group
+                    if 0 < self.objects[o].size <= self._BULK_PULL_MAX]
+            if len(bulk) > 1:
+                shared = self.loop.create_task(
+                    self.cluster.pull_objects(bulk, node_id))
+                for oid in bulk:
+                    task = self.loop.create_task(
+                        self._join_bulk_pull(shared, oid))
+                    self._pulls[oid] = task
+                    task.add_done_callback(
+                        lambda _f, o=oid: self._pulls.pop(o, None))
+            else:
+                bulk = []
+            for oid in group:
+                if oid not in bulk:
+                    # kicks the dedup task in _pull_remote; _descriptor's
+                    # own await joins it (parallel across oids and nodes)
+                    self.loop.create_task(self._pull_remote(oid))
+
+    async def _join_bulk_pull(self, shared: asyncio.Task, oid: str) -> bool:
+        """Per-oid view of one shared pull_objects RPC (the _pulls table
+        maps oid -> awaitable-of-bool)."""
+        try:
+            pulled = await shared
+        except Exception:  # noqa: BLE001 - node hiccup = not pulled
+            return False
+        if oid in pulled:
+            return True
+        # not in the bulk reply (evicted there?): one individual retry via
+        # the normal pull path before _descriptor declares it lost
+        meta = self.objects.get(oid)
+        if meta is None or not meta.location.startswith("remote:"):
+            return True  # raced: landed some other way
+        return await self.cluster.pull_object(
+            oid, meta.location.split(":", 1)[1])
 
     async def _descriptor(self, oid: str, deadline, _depth: int = 0):
         meta = self.objects[oid]

@@ -192,7 +192,44 @@ class NodeController(Controller):
             self._reply(w, p["req_id"], error=e)
 
 
-_DATA_CHUNK = 1 << 20  # 1 MiB frames on the data plane
+_DATA_CHUNK = 1 << 20     # 1 MiB frames on the data plane
+_PARALLEL_MIN = 4 << 20   # objects below this ride one stream (setup wins)
+_RANGE_MIN = 1 << 20      # never split a transfer finer than this per stream
+
+
+def transfer_streams() -> int:
+    """Stream fan-out for parallel object fetches
+    (RAY_TPU_TRANSFER_STREAMS, default 4)."""
+    try:
+        return max(1, int(os.environ.get("RAY_TPU_TRANSFER_STREAMS", "4")))
+    except ValueError:
+        return 4
+
+
+def use_parallel_transfer() -> bool:
+    """False pins the r5 single-stream sync path (RAY_TPU_TRANSFER_SYNC=1,
+    or RAY_TPU_TRANSFER_STREAMS=1) — the escape hatch when a peer can't
+    speak ranged reads or the fan-out misbehaves."""
+    if os.environ.get("RAY_TPU_TRANSFER_SYNC", "0") == "1":
+        return False
+    return transfer_streams() > 1
+
+
+def _record_transfer(nbytes: int, nstreams: int, seconds: float,
+                     retries: int = 0):
+    """Per-transfer data-plane tallies; read via
+    util.metrics.transfer_counters()."""
+    from ..util import metrics
+    metrics.get_or_create(metrics.Counter, "transfer_fetches").inc()
+    metrics.get_or_create(metrics.Counter, "transfer_fetch_bytes").inc(nbytes)
+    metrics.get_or_create(metrics.Counter,
+                          "transfer_fetch_streams").inc(nstreams)
+    if retries:
+        metrics.get_or_create(metrics.Counter,
+                              "transfer_stream_retries").inc(retries)
+    metrics.get_or_create(metrics.Histogram, "transfer_fetch_seconds",
+                          boundaries=[0.001, 0.01, 0.1, 1, 10, 100]
+                          ).observe(seconds)
 
 
 class ObjectDataServer:
@@ -203,6 +240,10 @@ class ObjectDataServer:
       client → `RTPU1 <token>\\n` then `GET <oid>\\n` (repeatable)
       server → `OK <size> <meta_len>\\n<contained oids space-joined>\\n<bytes>`
                | `MISS\\n`
+    Ranged form (r7, drives the parallel fetch — N streams each pull one
+    disjoint slice):
+      client → `GET <oid> <offset> <length>\\n`
+      server → `OK <length>\\n<bytes>` | `MISS\\n`
     Ref: object_manager.cc Push/Pull chunked transfers between plasma
     stores; ObjectManagerService rpc definitions in object_manager.proto."""
 
@@ -236,10 +277,14 @@ class ObjectDataServer:
                 if not line:
                     break
                 parts = line.decode("ascii", "replace").split()
-                if len(parts) != 2 or parts[0] != "GET":
+                if parts[:1] != ["GET"] or len(parts) not in (2, 4):
                     break
-                await self._serve_one(writer, parts[1])
-        except (OSError, asyncio.TimeoutError, UnicodeDecodeError):
+                if len(parts) == 2:
+                    await self._serve_one(writer, parts[1])
+                else:
+                    await self._serve_range(writer, parts[1],
+                                            int(parts[2]), int(parts[3]))
+        except (OSError, asyncio.TimeoutError, UnicodeDecodeError, ValueError):
             pass
         finally:
             try:
@@ -247,12 +292,13 @@ class ObjectDataServer:
             except OSError:
                 pass
 
-    async def _serve_one(self, writer, oid: str):
+    async def _await_ready(self, oid: str):
+        """Resolve `oid`'s meta, waiting out a still-computing local task —
+        the head may redirect a consumer here before the producer finishes
+        (same contract as _on_pull_object)."""
         c = self.c
         meta = c.objects.get(oid)
         if meta is not None and meta.location == "pending":
-            # the head may redirect a consumer here while a local task is
-            # still computing the object — wait like _on_pull_object does
             ev = c.object_events.get(oid)
             if ev is not None:
                 try:
@@ -262,6 +308,13 @@ class ObjectDataServer:
             meta = c.objects.get(oid)
         if (meta is None or meta.location not in ("shm", "spilled")
                 or not meta.size):
+            return None
+        return meta
+
+    async def _serve_one(self, writer, oid: str):
+        c = self.c
+        meta = await self._await_ready(oid)
+        if meta is None:
             writer.write(b"MISS\n")
             await writer.drain()
             return
@@ -280,10 +333,36 @@ class ObjectDataServer:
             await writer.drain()  # backpressure per chunk
         self.serve_bytes += len(blob)
 
+    async def _serve_range(self, writer, oid: str, offset: int, length: int):
+        """One slice of a parallel fetch: raw bytes, no meta lines (the
+        puller learned size/meta_len/contained from its redirect)."""
+        meta = await self._await_ready(oid)
+        if (meta is None or offset < 0 or length <= 0
+                or offset + length > meta.size):
+            writer.write(b"MISS\n")
+            await writer.drain()
+            return
+        try:
+            self.c._ensure_local(oid)
+            blob = self.c.store.read_range(oid, offset, length)
+        except Exception:  # noqa: BLE001 - segment vanished under us
+            writer.write(b"MISS\n")
+            await writer.drain()
+            return
+        writer.write(f"OK {len(blob)}\n".encode("ascii"))
+        for i in range(0, len(blob), _DATA_CHUNK):
+            writer.write(blob[i:i + _DATA_CHUNK])
+            await writer.drain()  # backpressure per chunk
+        self.serve_bytes += len(blob)
+
 
 async def direct_fetch(addr: str, oid: str, timeout: float = 120):
-    """Pull one blob from a sibling's ObjectDataServer. Returns an
-    _ingest_bytes payload dict, or None (owner gone / evicted / refused)."""
+    """Pull one blob from a sibling's ObjectDataServer over a single stream.
+    Returns an _ingest_bytes payload dict, or None (owner gone / evicted /
+    refused). The parallel path (parallel_fetch) supersedes this for large
+    objects; this remains the sync fallback and the small-object fast path
+    when no size is known up front."""
+    t0 = time.monotonic()
     host, port = addr.rsplit(":", 1)
     try:
         reader, writer = await asyncio.wait_for(
@@ -309,6 +388,7 @@ async def direct_fetch(addr: str, oid: str, timeout: float = 120):
             if not chunk:
                 return None  # owner hung up mid-stream
             buf.extend(chunk)
+        _record_transfer(size, 1, time.monotonic() - t0)
         return {"oid": oid, "enc": "blob", "data": bytes(buf), "size": size,
                 "meta_len": int(meta_len_s), "contained": contained}
     except (OSError, asyncio.TimeoutError, UnicodeDecodeError, ValueError):
@@ -318,6 +398,112 @@ async def direct_fetch(addr: str, oid: str, timeout: float = 120):
             writer.close()
         except OSError:
             pass
+
+
+async def _range_stream(addr: str, oid: str, view, offset: int, length: int,
+                        timeout: float) -> int:
+    """One parallel-fetch stream: land blob[offset:offset+length] straight
+    into `view` via recv_into (zero-copy: kernel → shm, no reassembly).
+    Returns bytes landed — short on any failure; the caller redistributes
+    the tail."""
+    loop = asyncio.get_running_loop()
+    host, port = addr.rsplit(":", 1)
+    got = 0
+    sock = _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM)
+    sock.setblocking(False)
+    try:
+        await asyncio.wait_for(loop.sock_connect(sock, (host, int(port))),
+                               timeout=10)
+        req = f"RTPU1 {cluster_token()}\nGET {oid} {offset} {length}\n"
+        await asyncio.wait_for(loop.sock_sendall(sock, req.encode()), timeout)
+        hdr = bytearray()
+        while not hdr.endswith(b"\n"):
+            b = await asyncio.wait_for(loop.sock_recv(sock, 1), timeout)
+            if not b or len(hdr) > 64:
+                return got
+            hdr += b
+        if not hdr.startswith(b"OK "):
+            return got
+        while got < length:
+            sub = view[offset + got:offset + length]
+            try:
+                n = await asyncio.wait_for(loop.sock_recv_into(sock, sub),
+                                           timeout)
+            finally:
+                sub.release()  # the store seals only once all views die
+            if n == 0:
+                return got  # owner hung up mid-range
+            got += n
+        return got
+    except (OSError, asyncio.TimeoutError, ValueError):
+        return got
+    finally:
+        sock.close()
+
+
+async def parallel_fetch(addrs, oid: str, size: int, meta_len: int,
+                         contained, store, timeout: float = 120):
+    """Chunked parallel fetch of one blob into a preallocated store segment:
+    N concurrent streams (RAY_TPU_TRANSFER_STREAMS) each recv_into a
+    disjoint slice, split round-robin across every known holder. A stream
+    that dies mid-transfer has its tail redistributed to the surviving
+    holders; total failure aborts the segment and returns None (caller
+    falls back to the head-staged uplink). Success returns an
+    _ingest_bytes payload with enc="direct" — the bytes are already in
+    the store."""
+    addrs = [a for a in addrs if a]
+    if not addrs or not size or store is None:
+        return None
+    nstreams = int(min(transfer_streams(), max(1, size // _RANGE_MIN)))
+    if size < _PARALLEL_MIN:
+        nstreams = 1
+    t0 = time.monotonic()
+    try:
+        handle = store.create_writable(oid, size)
+    except Exception:  # noqa: BLE001 - no room / stale segment pinned
+        return None
+    view = handle.view
+    base = size // nstreams
+    ranges = []
+    for i in range(nstreams):
+        off = i * base
+        ln = size - off if i == nstreams - 1 else base
+        ranges.append((addrs[i % len(addrs)], off, ln))
+    streams_opened = 0
+    retries = 0
+    ok = False
+    try:
+        for _round in range(3):
+            streams_opened += len(ranges)
+            if _round:
+                retries += len(ranges)
+            results = await asyncio.gather(
+                *[_range_stream(a, oid, view, off, ln, timeout)
+                  for a, off, ln in ranges])
+            leftover = [(a, off + got, ln - got)
+                        for (a, off, ln), got in zip(ranges, results)
+                        if got < ln]
+            if not leftover:
+                ok = True
+                break
+            # redistribute dead streams' tails to the OTHER holders; with a
+            # single holder, retry it (covers transient mid-transfer resets)
+            ranges = []
+            for i, (a, off, ln) in enumerate(leftover):
+                others = [x for x in addrs if x != a] or [a]
+                ranges.append((others[i % len(others)], off, ln))
+    finally:
+        view = None
+        if ok:
+            handle.seal()
+        else:
+            handle.abort()
+    if not ok:
+        return None
+    _record_transfer(size, streams_opened, time.monotonic() - t0,
+                     retries=retries)
+    return {"oid": oid, "enc": "direct", "size": size, "meta_len": meta_len,
+            "contained": list(contained or [])}
 
 
 class NodeAgent:
@@ -400,6 +586,8 @@ class NodeAgent:
             # COMPUTING (the head learned the oid via locate_object) — wait
             # for it rather than replying not-found
             self.c.loop.create_task(self._on_pull_object(p))
+        elif kind == "pull_objects":
+            self.c.loop.create_task(self._on_pull_objects(p))
         elif kind == "locate_object":
             meta = c.objects.get(p["oid"])
             if meta is None:
@@ -471,6 +659,31 @@ class NodeAgent:
             oids.append(oid)
         return oids
 
+    def _holds(self, oid: str):
+        """Fire-and-forget holder registration: the head records this node
+        as an extra source for `oid`, so later pulls can fan streams out
+        across peers (multi-peer parallel fetch)."""
+        if self.writer is not None:
+            try:
+                protocol.awrite_msg(self.writer, "holds_object", oid=oid)
+            except OSError:
+                pass
+
+    async def _fetch_direct(self, d: dict, timeout: float = 120):
+        """Chunked-parallel pull of a redirected dep (every holder the head
+        knows), falling back to the r5 single stream when parallelism is
+        off or the redirect carries no size."""
+        oid = d["oid"]
+        payload = None
+        if use_parallel_transfer() and d.get("size"):
+            payload = await parallel_fetch(
+                d.get("addrs") or [d["addr"]], oid, d["size"],
+                d.get("meta_len", 0), d.get("contained"), self.c.store,
+                timeout=timeout)
+        if payload is None:
+            payload = await direct_fetch(d["addr"], oid, timeout=timeout)
+        return payload
+
     async def _direct_pull(self, d: dict):
         """Pull a redirected dep straight from its owner's data server;
         fall back to a head-staged fetch if the owner is gone/evicted, and
@@ -478,10 +691,11 @@ class NodeAgent:
         _pull_uplink)."""
         oid = d["oid"]
         try:
-            payload = await direct_fetch(d["addr"], oid)
+            payload = await self._fetch_direct(d)
             if payload is not None:
                 self.direct_pull_bytes += payload["size"]
                 self.c._ingest_bytes(oid, payload)
+                self._holds(oid)
                 return
             ok = False
             try:
@@ -561,35 +775,48 @@ class NodeAgent:
             # submit are already released; _evict guards on pinned)
             self.c.decref(list(dep_oids))
 
-    async def _on_pull_object(self, p: dict):
+    async def _pull_payload(self, oid: str, timeout: float) -> dict:
+        """Build one pull reply: waits out a still-computing object, then
+        ships inline value or packed blob (shared by the single pull RPC
+        and the batched pull_objects frame)."""
         c = self.c
-        oid = p["oid"]
         meta = c.objects.get(oid)
         if meta is not None and meta.location == "pending":
             ev = c.object_events.get(oid)
             if ev is not None:
                 try:
-                    await asyncio.wait_for(ev.wait(), p.get("timeout", 120))
+                    await asyncio.wait_for(ev.wait(), timeout)
                 except asyncio.TimeoutError:
                     pass
             meta = c.objects.get(oid)
         if meta is None or meta.location in ("pending", "error"):
-            self._reply(p["req_id"], found=False)
-            return
+            return {"oid": oid, "found": False}
         if meta.location == "inline":
-            self._reply(p["req_id"], found=True, enc="inline",
-                        data=meta.inline_value, size=meta.size,
-                        contained=list(meta.contained))
-            return
+            return {"oid": oid, "found": True, "enc": "inline",
+                    "data": meta.inline_value, "size": meta.size,
+                    "contained": list(meta.contained)}
         try:
             c._ensure_local(oid)
             blob = c.store.read_raw(oid)
         except Exception:  # noqa: BLE001 - segment vanished
-            self._reply(p["req_id"], found=False)
-            return
-        self._reply(p["req_id"], found=True, enc="blob", data=blob,
-                    size=meta.size, meta_len=meta.meta_len,
-                    contained=list(meta.contained))
+            return {"oid": oid, "found": False}
+        return {"oid": oid, "found": True, "enc": "blob", "data": blob,
+                "size": meta.size, "meta_len": meta.meta_len,
+                "contained": list(meta.contained)}
+
+    async def _on_pull_object(self, p: dict):
+        r = await self._pull_payload(p["oid"], p.get("timeout", 120))
+        r.pop("oid", None)
+        self._reply(p["req_id"], **r)
+
+    async def _on_pull_objects(self, p: dict):
+        """Batched pull: one RPC ships a whole get()-list's worth of
+        objects held here (O(nodes) round trips for a batched get, not
+        O(refs))."""
+        results = []
+        for oid in p["oids"]:
+            results.append(await self._pull_payload(oid, p.get("timeout", 90)))
+        self._reply(p["req_id"], results=results)
 
     # ----------------------------------------------------------- uplink rpc
     def _reply(self, req_id, **payload):
@@ -619,10 +846,12 @@ class NodeAgent:
         if not p.get("found"):
             return False
         if p.get("enc") == "redirect":
-            payload = await direct_fetch(p["addr"], oid, timeout=timeout)
+            payload = await self._fetch_direct({**p, "oid": oid},
+                                               timeout=timeout)
             if payload is not None:
                 self.direct_pull_bytes += payload["size"]
                 self.c._ingest_bytes(oid, payload)
+                self._holds(oid)
                 return True
             if no_redirect:
                 return False

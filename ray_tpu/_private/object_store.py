@@ -69,6 +69,44 @@ class LocalObject:
         self.nbytes = nbytes
 
 
+class WritableBuffer:
+    """Preallocated store segment exposed as a writable memoryview.
+
+    Transfer streams `recv_into` disjoint slices of `.view` so bytes land
+    in their final shm location with zero copies (plasma Create→write→Seal
+    semantics). Callers must release every sub-view of `.view` before
+    seal()/abort() — the pershm backend cannot close a mapping with live
+    exports."""
+
+    __slots__ = ("_store", "object_id", "view", "_shm", "_done")
+
+    def __init__(self, store, object_id, view, shm=None):
+        self._store = store
+        self.object_id = object_id
+        self.view = view
+        self._shm = shm
+        self._done = False
+
+    def seal(self):
+        """Bytes are complete; detach this handle (segment persists)."""
+        if self._done:
+            return
+        self._done = True
+        self.view = None
+        if self._shm is not None:
+            self._shm.close()
+
+    def abort(self):
+        """Transfer failed; free the preallocated segment."""
+        if self._done:
+            return
+        self._done = True
+        self.view = None
+        if self._shm is not None:
+            self._shm.close()
+        self._store.delete_segment(self.object_id)
+
+
 class StoreClient:
     """Per-process store client. Thread-safe for CPython practical purposes.
 
@@ -132,6 +170,26 @@ class StoreClient:
             pos += b.nbytes
         shm.close()
         return size
+
+    def create_writable(self, object_id: str, size: int) -> WritableBuffer:
+        """Preallocate the object's backing storage and hand back a writable
+        view of exactly `size` bytes (plasma Create: allocate first, fill
+        from the wire, Seal). Parallel transfer streams recv_into disjoint
+        slices of the view, so there is no reassembly copy."""
+        if self._slab is not None:
+            off = self._slab.alloc(object_id, max(size, 1))
+            return WritableBuffer(self, object_id,
+                                  self._slab.view(off, max(size, 1)))
+        try:
+            shm = shared_memory.SharedMemory(name=seg_name(object_id),
+                                             create=True, size=max(size, 1))
+        except FileExistsError:
+            # stale segment from a crashed/retried transfer of the same oid
+            self.delete_segment(object_id)
+            shm = shared_memory.SharedMemory(name=seg_name(object_id),
+                                             create=True, size=max(size, 1))
+        _unregister(shm)
+        return WritableBuffer(self, object_id, shm.buf, shm=shm)
 
     def put_raw(self, object_id: str, blob: bytes) -> int:
         """Store pre-packed bytes (used when restoring spilled objects)."""
@@ -202,6 +260,22 @@ class StoreClient:
         shm = shared_memory.SharedMemory(name=seg_name(object_id))
         _unregister(shm)
         data = bytes(shm.buf)
+        shm.close()
+        return data
+
+    def read_range(self, object_id: str, offset: int, length: int) -> bytes:
+        """Copy out one slice of the packed blob — the data server's ranged
+        GET path (copies `length` bytes, not the whole object)."""
+        if self._slab is not None:
+            loc = self._slab.lookup(object_id)
+            if loc is None:
+                raise FileNotFoundError(object_id)
+            off, size = loc
+            mv = self._slab.view(off, size)
+            return bytes(mv[offset:offset + length])
+        shm = shared_memory.SharedMemory(name=seg_name(object_id))
+        _unregister(shm)
+        data = bytes(shm.buf[offset:offset + length])
         shm.close()
         return data
 

@@ -74,3 +74,7 @@ class ObjectMeta:
     # object ids serialized inside this object's bytes; each holds a refcount
     # until this object is evicted (nested-ref containment)
     contained: List[str] = field(default_factory=list)
+    # head-side only: nodes (beyond the authoritative `location`) known to
+    # hold a copy — extra sources for multi-peer parallel fetch. Best-effort:
+    # a stale holder just MISSes and the fetch redistributes.
+    holders: List[str] = field(default_factory=list)

@@ -95,7 +95,7 @@ class LabelEncoder(Preprocessor):
 
     def _fit(self, ds: Dataset) -> None:
         seen = set()
-        for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
+        for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=1):
             seen.update(np.asarray(batch[self.label_column]).tolist())
         self.classes_ = np.array(sorted(seen))
 
@@ -158,7 +158,7 @@ def _fit_minmax(ds: Dataset, columns: List[str]) -> Dict[str, tuple]:
     KBinsDiscretizer's uniform strategy)."""
     lo = {c: np.inf for c in columns}
     hi = {c: -np.inf for c in columns}
-    for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
+    for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=1):
         for c in columns:
             lo[c] = min(lo[c], float(batch[c].min()))
             hi[c] = max(hi[c], float(batch[c].max()))
@@ -170,7 +170,7 @@ def _fit_moments(ds: Dataset, columns: List[str],
     """One streaming pass → {col: (sum, sumsq, n)} (shared by
     StandardScaler and SimpleImputer's mean strategy)."""
     acc = {c: [0.0, 0.0, 0] for c in columns}
-    for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=0):
+    for batch in ds.iter_batches(batch_format="numpy", prefetch_batches=1):
         for c in columns:
             v = np.asarray(batch[c], np.float64)
             if skip_nan:
@@ -208,7 +208,7 @@ class SimpleImputer(Preprocessor):
             from collections import Counter
             counts = {c: Counter() for c in self.columns}
             for batch in ds.iter_batches(batch_format="numpy",
-                                         prefetch_batches=0):
+                                         prefetch_batches=1):
                 for c in self.columns:
                     vals = [v for v in np.asarray(batch[c]).tolist()
                             if not _is_missing(v)]
@@ -289,6 +289,8 @@ class KBinsDiscretizer(Preprocessor):
             cap = 100_000
             sample = {c: [] for c in self.columns}
             seen = 0
+            # no prefetch: this loop BREAKS at the sample cap, and
+            # lookahead would compute blocks past it for nothing
             for batch in ds.iter_batches(batch_format="numpy",
                                          prefetch_batches=0):
                 for c in self.columns:
@@ -321,7 +323,7 @@ class OneHotEncoder(Preprocessor):
     def _fit(self, ds: Dataset) -> None:
         seen = {c: set() for c in self.columns}
         for batch in ds.iter_batches(batch_format="numpy",
-                                     prefetch_batches=0):
+                                     prefetch_batches=1):
             for c in self.columns:
                 # missing (None / NaN) is NOT a category: it encodes as
                 # the all-zeros row, same as an unseen value (and NaN !=

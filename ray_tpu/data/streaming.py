@@ -189,6 +189,23 @@ class StreamingExecutor:
                 num_cpus=1, num_returns=num_returns, name=f"data::{key}")(fn)
         return self._remote_cache[key]
 
+    def _remote_at(self, key, fn, owner, num_returns=1):
+        """Owner-tagged variant: a soft locality hint steers the map task to
+        the node already holding its input block, so a shuffle-free pipeline
+        moves ~no block bytes across nodes. The scheduler falls back to
+        DEFAULT placement when the owner has no room — a hint, not a pin."""
+        if owner is None:
+            return self._remote(key, fn, num_returns)
+        ck = (key, owner)
+        if ck not in self._remote_cache:
+            from ray_tpu.util.scheduling_strategies import (
+                NodeAffinitySchedulingStrategy)
+            self._remote_cache[ck] = self._remote(
+                key, fn, num_returns).options(
+                    scheduling_strategy=NodeAffinitySchedulingStrategy(
+                        node_id=owner, soft=True, locality_hint=True))
+        return self._remote_cache[ck]
+
     # ------------------------------------------------------------ plumbing
     def _sizes(self, refs):
         try:
@@ -196,6 +213,13 @@ class StreamingExecutor:
             return _state.global_client().object_sizes([r.id for r in refs])
         except Exception:  # noqa: BLE001 - size is advisory
             return [1 << 20] * len(refs)
+
+    def _owner(self, ref):
+        try:
+            from ray_tpu._private import state as _state
+            return _state.global_client().object_locations([ref.id])[0]
+        except Exception:  # noqa: BLE001 - locality is advisory
+            return None
 
     @staticmethod
     def _is_barrier(st) -> bool:
@@ -258,14 +282,14 @@ class StreamingExecutor:
                     idx, ref = st.pop_input()
                     if st.t0 is None:
                         st.t0 = time.perf_counter()
+                    rfn = self._remote_at(f"{i}:{st.name}", st.fn,
+                                          self._owner(ref))
                     if getattr(st.fn, "indexed", False):
                         # indexed ops get the stable queue index so seeded
                         # per-block randomness can't collide across blocks
-                        out = self._remote(f"{i}:{st.name}",
-                                           st.fn).remote(ref, idx)
+                        out = rfn.remote(ref, idx)
                     else:
-                        out = self._remote(f"{i}:{st.name}",
-                                           st.fn).remote(ref)
+                        out = rfn.remote(ref)
                     st.inflight[out] = idx
             else:
                 op = st.op
@@ -297,15 +321,16 @@ class StreamingExecutor:
                     if st.t0 is None:
                         st.t0 = time.perf_counter()
                     extra = () if op.sample_fn is None else (st.ctx,)
+                    owner = self._owner(ref)
                     if op.num_partitions == 1:
                         # num_returns=1 would store the whole 1-tuple as the
                         # result; unwrap in-task so reduce gets a block
-                        parts = [self._remote(
-                            f"{i}:{st.name}.map", _single_part_map,
+                        parts = [self._remote_at(
+                            f"{i}:{st.name}.map", _single_part_map, owner,
                         ).remote(ref, op.map_fn, idx, *extra)]
                     else:
-                        parts = self._remote(
-                            f"{i}:{st.name}.map", op.map_fn,
+                        parts = self._remote_at(
+                            f"{i}:{st.name}.map", op.map_fn, owner,
                             num_returns=op.num_partitions,
                         ).remote(ref, op.num_partitions, idx, *extra)
                     st.map_inflight[parts[0]] = (idx, parts)

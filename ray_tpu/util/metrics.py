@@ -169,3 +169,57 @@ def control_roundtrips_total() -> int:
 def control_frames_sent_total() -> int:
     from ray_tpu._private import protocol
     return protocol.frames_sent_total()
+
+
+# -- data-plane / scheduler locality read surface ----------------------------
+# The raw series are ordinary registry metrics written by the transfer path
+# (_private/node_agent.py parallel_fetch/direct_fetch) and the locality
+# scheduler (_private/cluster.py _default_place). These helpers flatten them
+# into plain numbers so benchmarks and tests can assert on deltas without
+# touching registry internals. All read the CURRENT process — the head sees
+# its own pulls and every placement decision; each node sees its own pulls.
+
+def _counter_total(name: str) -> float:
+    with _registry_lock:
+        m = _registry.get(name)
+    if not isinstance(m, Counter):
+        return 0.0
+    return sum(m.snapshot()["values"].values())
+
+
+def transfer_counters() -> Dict[str, float]:
+    """Per-process parallel-transfer tallies: fetches completed, bytes
+    landed, streams opened, stream retries (redistributed tails), and total
+    seconds spent transferring."""
+    with _registry_lock:
+        hist = _registry.get("transfer_fetch_seconds")
+    seconds = 0.0
+    if isinstance(hist, Histogram):
+        seconds = sum(hist.snapshot()["sum"].values())
+    return {"fetches": _counter_total("transfer_fetches"),
+            "bytes": _counter_total("transfer_fetch_bytes"),
+            "streams": _counter_total("transfer_fetch_streams"),
+            "retries": _counter_total("transfer_stream_retries"),
+            "seconds": seconds}
+
+
+def transfer_bytes_total() -> int:
+    return int(_counter_total("transfer_fetch_bytes"))
+
+
+def sched_locality_counters() -> Dict[str, float]:
+    """Locality-aware placement tallies (head process): hits = tasks placed
+    on the node already holding the most arg bytes, misses = arg bytes
+    existed but placement couldn't honor them, bytes = arg bytes that were
+    local to the chosen node at placement time."""
+    return {"hits": _counter_total("sched_locality_hits"),
+            "misses": _counter_total("sched_locality_misses"),
+            "bytes": _counter_total("sched_locality_bytes")}
+
+
+def sched_locality_hit_rate() -> float:
+    """hits / (hits + misses); 1.0 when no locality-scored placement has
+    happened yet (nothing was ever missed)."""
+    c = sched_locality_counters()
+    total = c["hits"] + c["misses"]
+    return 1.0 if total == 0 else c["hits"] / total

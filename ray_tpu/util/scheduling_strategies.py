@@ -29,6 +29,11 @@ class PlacementGroupSchedulingStrategy:
 class NodeAffinitySchedulingStrategy:
     node_id: str = ""
     soft: bool = True
+    # set by the data layer when the affinity is a derived data-locality
+    # hint (input block's owner) rather than a user pin: the scheduler then
+    # prefers the node only while it has room (falling back to DEFAULT
+    # placement under pressure) and tallies sched_locality_* metrics
+    locality_hint: bool = False
 
 
 # string strategies: "DEFAULT" | "SPREAD"

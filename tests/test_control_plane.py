@@ -222,6 +222,22 @@ def test_core_bench_smoke():
     assert rec["fanout"]["submit_rt"] <= 1
 
 
+def test_transfer_bench_smoke():
+    """transfer_bench --smoke is the tier-1 data-plane invariant check:
+    parallel fetch lands bytes intact, batched get preserves order, and
+    owner-tagged pipeline maps hit their block's node ≥ 90% of the time
+    while moving ~no block bytes across nodes."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    out = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "transfer_bench.py"), "--smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=240)
+    assert out.returncode == 0, (out.stdout[-1000:], out.stderr[-2000:])
+    rec = json.loads(out.stdout.strip().splitlines()[-1])
+    assert rec["pipeline"]["locality_hit_rate"] >= 0.9
+    assert rec["pipeline"]["cross_node_block_bytes"] < (1 << 20)
+
+
 def test_sync_submit_escape_hatch():
     """RAY_TPU_SYNC_SUBMIT=1 restores the blocking control plane end to end
     (the core_bench baseline mode must stay a faithful fallback)."""
