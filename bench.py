@@ -35,7 +35,7 @@ llama_125m, so the round always records SOME number with rc=0. The final JSON
 line is the merged record:
 {"metric", "value", "unit", "vs_baseline", "mfu", "backend", ...,
  "serving_b8": {...}, "serving_b32": {...}, "rllib_ppo": {...},
- "core_cp": {...}, "transfer_dp": {...}}.
+ "core_cp": {...}, "transfer_dp": {...}, "chain_dp": {...}}.
 vs_baseline compares against the newest prior BENCH_r*.json with the same
 metric name (the reference fork publishes no numbers — BASELINE.json
 "published" is {} — so our own history is the baseline).
@@ -192,7 +192,7 @@ def _kill_stale_workers():
         except (ProcessLookupError, PermissionError):
             pass
     for pat in (r"bench\.py --measure",
-                r"benchmarks/(serving|rllib|decode|transfer)_bench\.py"):
+                r"benchmarks/(serving|rllib|decode|transfer|chain)_bench\.py"):
         for pid in _pgrep(pat):
             try:
                 _log(f"bench: killing stray bench child pid={pid} ({pat})")
@@ -597,7 +597,8 @@ def orchestrate():
                 ("serving_b32", "serving_bench.py", 900, {"B": "32"}),
                 ("rllib_ppo", "rllib_bench.py", 600, None),
                 ("core_cp", "core_bench.py", 300, None),
-                ("transfer_dp", "transfer_bench.py", 300, None)):
+                ("transfer_dp", "transfer_bench.py", 300, None),
+                ("chain_dp", "chain_bench.py", 300, None)):
             result[key] = _run_aux_bench(script, tmo, extra)
             # re-emit the merged-so-far record (NOT a bare keyed line): the
             # last complete JSON line on stdout is always a full headline

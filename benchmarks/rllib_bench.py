@@ -25,15 +25,13 @@ if "--measure" in sys.argv[1:]:
     if _fake_hang and os.environ.get("JAX_PLATFORMS") != "cpu":
         time.sleep(float(_fake_hang))
 
-    # env-var platform switching (JAX_PLATFORMS=cpu) races this image's
-    # sitecustomize-initialized remote-compile hook and can hang the first
-    # compile; flipping via jax.config after import is reliable
-    # (conftest.py pattern — see axon notes). Measure-child only: the
-    # parent must not import jax nor mutate the env its rungs inherit.
+    # CPU-scrub rung: JAX_PLATFORMS=cpu must STAY in the env through the
+    # jax import (BENCH_r05: popping it first re-engaged the accelerator
+    # path and wedged init — all three aux slots recorded init_hang). With
+    # the env var held, the import itself pins the cpu backend and worker
+    # children inherit the same env before THEIR imports.
     if os.environ.get("JAX_PLATFORMS") == "cpu":
-        os.environ.pop("JAX_PLATFORMS")
-        import jax as _jax
-        _jax.config.update("jax_platforms", "cpu")
+        import jax as _jax  # noqa: F401 - imported for backend pinning
 
 
 def main():

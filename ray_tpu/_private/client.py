@@ -45,6 +45,51 @@ def _sync_submit_requested() -> bool:
         "1", "true", "yes")
 
 
+def _prefetch_enabled() -> bool:
+    # mirrors controller.prefetch_enabled() without importing the whole
+    # controller module into every worker process
+    return os.environ.get("RAY_TPU_PREFETCH", "1").lower() not in (
+        "0", "false", "no")
+
+
+class _SingleFlight:
+    """In-flight fetch dedup (ref: raylet pull dedup / golang singleflight):
+    the first getter of a key owns the wire fetch, concurrent getters join
+    its future instead of issuing a duplicate RPC. Resolved/failed claims
+    leave the table, so later gets re-fetch fresh state."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._futs = {}
+
+    def claim(self, keys):
+        """Partition `keys` into (owned, joined): `owned` keys are this
+        caller's to fetch (and then resolve/fail — ALWAYS, or joiners hang);
+        `joined` maps each in-flight key to its owner's future."""
+        owned, joined = [], {}
+        with self._lock:
+            for k in keys:
+                f = self._futs.get(k)
+                if f is None:
+                    self._futs[k] = concurrent.futures.Future()
+                    owned.append(k)
+                else:
+                    joined[k] = f
+        return owned, joined
+
+    def resolve(self, key, result):
+        with self._lock:
+            f = self._futs.pop(key, None)
+        if f is not None and not f.done():
+            f.set_result(result)
+
+    def fail(self, key, err):
+        with self._lock:
+            f = self._futs.pop(key, None)
+        if f is not None and not f.done():
+            f.set_exception(err)
+
+
 class _DeltaFlusher:
     """Coalesces small control messages into ordered multi-entry batches.
 
@@ -66,10 +111,14 @@ class _DeltaFlusher:
         self._wake = threading.Event()
         self._thread = None
 
-    def append(self, entry, nbytes=0):
+    def append(self, entry, nbytes=0, urgent=False):
         with self.lock:
             self._entries.append(entry)
             self._bytes += nbytes
+            if urgent:
+                # latency-sensitive entry (e.g. a task_done publication):
+                # the timer flushes without the coalescing nap
+                self._urgent = True
             if self._closed:
                 # post-close stragglers (interpreter teardown): best effort,
                 # but never from inside an active sink — a nested send would
@@ -406,6 +455,7 @@ class WorkerClient(BaseClient):
         self._lock = threading.RLock()
         self._pipelined = not _sync_submit_requested()
         self._flusher = _DeltaFlusher(self._send_batch, self._lock)
+        self._getflight = _SingleFlight()  # cross-thread get dedup
         self._reqs = {}
         self._req_counter = 0
         self.task_queue = []  # consumed by worker_main
@@ -531,14 +581,34 @@ class WorkerClient(BaseClient):
         if tid:
             self._send("blocked", task_id=tid)
         try:
-            # dedup: each unique object crosses the wire (and pulls) once
+            # dedup: each unique object crosses the wire (and pulls) once —
+            # across exec THREADS too: concurrent getters of an oid join the
+            # owner's in-flight claim instead of issuing their own RPC
             uniq = list(dict.fromkeys(oids))
-            p = self._rpc("get", oids=uniq, timeout=timeout)
+            owned, joined = self._getflight.claim(uniq)
+            descs = {}
+            if owned:
+                try:
+                    p = self._rpc("get", oids=owned, timeout=timeout)
+                except BaseException as e:
+                    for o in owned:
+                        self._getflight.fail(o, e)
+                    raise
+                for o, d in zip(owned, p["results"]):
+                    descs[o] = d
+                    self._getflight.resolve(o, d)
+            for o, f in joined.items():
+                try:
+                    descs[o] = f.result(timeout)
+                except Exception:
+                    # the owner's fetch failed (or ITS deadline expired):
+                    # retry directly instead of inheriting the failure
+                    descs[o] = self._rpc(
+                        "get", oids=[o], timeout=timeout)["results"][0]
         finally:
             if tid:
                 self._send("unblocked", task_id=tid)
-        by_oid = dict(zip(uniq, p["results"]))
-        return self._materialize(oids, [by_oid[o] for o in oids])
+        return self._materialize(oids, [descs[o] for o in oids])
 
     def put(self, value):
         oid = ids.object_id()
@@ -558,6 +628,22 @@ class WorkerClient(BaseClient):
         """Store a task result; returns (oid, meta_len, size, inline, contained)."""
         meta_len, size, inline, contained = self._encode_to_store(oid, value)
         return (oid, meta_len, size, inline, contained)
+
+    def send_task_done(self, task_id, results, error):
+        """Publish a task's completion. With prefetching dispatch on, the
+        entry rides the ordered batch flusher (fire-and-forget: the exec
+        thread is free for the next task without awaiting application, and
+        since every blocking RPC force-flushes first, a later decref can
+        never be applied before this publication — put-before-decref holds
+        transitively). Legacy mode keeps the direct ordered frame."""
+        if self._pipelined and _prefetch_enabled():
+            # urgent: the flusher timer skips its coalescing nap — callers
+            # may already be blocked in ray.get() on these results
+            self._flusher.append(("task_done", task_id, results, error),
+                                 urgent=True)
+        else:
+            self._send("task_done", task_id=task_id, results=results,
+                       error=error)
 
     def wait(self, oids, num_returns, timeout):
         tid = self.current_task_id

@@ -45,6 +45,26 @@ _INLINE_MAX = 64 * 1024
 DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_STORE_BYTES", 8 << 30))
 
 
+def prefetch_enabled() -> bool:
+    """Dependency-prefetching dispatch (ref: raylet dependency manager):
+    remote ref args of queued tasks are pulled eagerly, exec frames carry
+    shm/inline descriptors for locally resident args, and workers publish
+    task results through the batched flusher. RAY_TPU_PREFETCH=0 restores
+    the legacy exec-time-fetch path end to end."""
+    return os.environ.get("RAY_TPU_PREFETCH", "1").lower() not in (
+        "0", "false", "no")
+
+
+def prefetch_max_bytes() -> int:
+    """In-flight byte cap for eager pulls; excess requests queue until a
+    pull completes (backpressure, not rejection)."""
+    try:
+        return int(os.environ.get("RAY_TPU_PREFETCH_MAX_BYTES",
+                                  str(256 << 20)))
+    except ValueError:
+        return 256 << 20
+
+
 @dataclass
 class TaskRecord:
     spec: TaskSpec
@@ -65,6 +85,10 @@ class TaskRecord:
     pinned_streams: List[str] = field(default_factory=list)
     node_id: Optional[str] = None  # set when forwarded to a cluster node
     fwd_seq: Optional[int] = None  # per-node ship sequence (cluster.py stats)
+    # args this task already gated a dispatch on waiting for an eager pull —
+    # each arg gates at most once, so a failed pull degrades to the legacy
+    # exec-time fetch instead of re-gating forever
+    prefetch_tried: Set[str] = field(default_factory=set)
 
 
 class _ReadyIndex:
@@ -398,10 +422,19 @@ class Controller:
         self._cluster_port = cluster_port
         self.cluster = None
         self._pulls: Dict[str, asyncio.Task] = {}  # in-flight remote pulls
+        # eager dependency pulls (single-flight per oid, byte-capped); built
+        # in start() once the event loop exists
+        self.prefetch = None
 
     # ------------------------------------------------------------------ setup
     async def start(self):
         self.loop = asyncio.get_running_loop()
+        # lazy import: node_agent imports this module at its top level, and
+        # Controller never needs PullManager until a loop exists
+        from .node_agent import PullManager
+        self.prefetch = PullManager(
+            self.loop, max_bytes=prefetch_max_bytes(),
+            pin=self._pin_for_pull, unpin=self._unpin_for_pull)
         self._server = await asyncio.start_unix_server(self._on_conn, path=self.socket_path)
         self.loop.create_task(self._reaper())
         if self._cluster_port is not None:
@@ -683,6 +716,25 @@ class Controller:
                 self._worker_open_stream(w, e[1])
             elif op == "close_stream":
                 self._worker_close_stream(w, e[1])
+            elif op == "task_done":
+                # fire-and-forget result publication: the worker appended its
+                # completion behind its result puts in the SAME ordered batch
+                # (put-before-decref holds transitively), freeing it to start
+                # the next task without awaiting this application
+                from ..util import metrics
+                results = e[2] or []
+                metrics.get_or_create(
+                    metrics.Counter, "result_async_tasks").inc()
+                if results:
+                    metrics.get_or_create(
+                        metrics.Counter, "result_async_results").inc(
+                            len(results))
+                    nbytes = sum(r[2] or 0 for r in results)
+                    if nbytes:
+                        metrics.get_or_create(
+                            metrics.Counter, "result_async_bytes").inc(nbytes)
+                self._on_task_done(
+                    w, {"task_id": e[1], "results": results, "error": e[3]})
 
     def apply_batch_local(self, entries):
         """Driver-side batch: same entries, no per-worker tally (driver refs
@@ -835,6 +887,13 @@ class Controller:
                 if meta is None or meta.location == "pending":
                     rec.deps_remaining.add(v)
                     self.dep_waiters[v].add(spec.task_id)
+                elif (meta.location.startswith("remote:")
+                        and prefetch_enabled()
+                        and self._prefetch_worthwhile(spec, meta)):
+                    # queue admission: start moving the bytes NOW, long
+                    # before a worker slot frees (dispatch gates in
+                    # _enqueue_ready until the pull lands)
+                    self._prefetch_request(v)
         # refs buried inside inline arg values: pin (alive) but don't treat as
         # dispatch deps — the task body fetches them itself if it wants them.
         # Actor handles ride the same list (prefix dispatch): the actor stays
@@ -918,6 +977,8 @@ class Controller:
                 actor.in_flight.add(rec.spec.task_id)
                 self.cluster.forward_method(rec, node)
                 return
+            if self._gate_on_prefetch(rec):
+                return  # head-hosted actor: hold until eager pulls land
             actor.queue.append(rec)
         else:
             if (self.cluster is not None
@@ -952,6 +1013,8 @@ class Controller:
                     # actor-creation options resolve inside _forward
                     self.cluster.forward_task(rec, node)
                     return
+            if self._gate_on_prefetch(rec):
+                return  # head-bound task: hold until eager pulls land
             self.ready_queue.append(rec)
 
     # -------------------------------------------------------------- scheduling
@@ -1364,6 +1427,10 @@ class Controller:
             tpu_capable = (actor.creation_spec is not None and
                            actor.creation_spec.resources.get("TPU", 0) > 0)
             env.update({k: str(v) for k, v in (actor.env or {}).items()})
+            # the worker's exec pool honors the actor's declared concurrency
+            # (ref: ray core max_concurrency) instead of a fixed 64 threads
+            mc = getattr(actor.options, "max_concurrency", 1) or 1
+            env["RAY_TPU_MAX_CONCURRENCY"] = str(max(1, int(mc)))
         if not tpu_capable:
             for k in self._TPU_ENV_KEYS:
                 env.pop(k, None)
@@ -1424,7 +1491,13 @@ class Controller:
         w.running.add(rec.spec.task_id)
         if w.actor_id is None:
             w.state = "busy"
-        protocol.awrite_msg(w.writer, "exec", spec=rec.spec, result_oids=rec.result_oids)
+        if prefetch_enabled():
+            protocol.awrite_msg(w.writer, "exec", spec=rec.spec,
+                                result_oids=rec.result_oids,
+                                arg_descs=self._arg_descriptors(rec))
+        else:  # legacy frame, byte-identical to the pre-prefetch protocol
+            protocol.awrite_msg(w.writer, "exec", spec=rec.spec,
+                                result_oids=rec.result_oids)
 
     # -------------------------------------------------------------- completion
     def _on_task_done(self, w: WorkerConn, p: dict):
@@ -1643,6 +1716,17 @@ class Controller:
         meta.location = f"remote:{node_id}"
         meta.holders = []  # fresh authoritative copy: old holders are stale
         self.object_events[oid].set()
+        if prefetch_enabled():
+            # production moment: if a queued task is waiting on this object
+            # and can't follow it to the holder, start the pull before the
+            # waiter even dispatches (must run BEFORE _resolve_dep pops the
+            # waiter set)
+            for tid in self.dep_waiters.get(oid, ()):
+                rec = self.tasks.get(tid)
+                if (rec is not None
+                        and self._prefetch_worthwhile(rec.spec, meta)):
+                    self._prefetch_request(oid)
+                    break
         self._resolve_dep(oid)
 
     def _ingest_bytes(self, oid: str, p: dict):
@@ -1704,6 +1788,156 @@ class Controller:
             self._pulls[oid] = task
             task.add_done_callback(lambda _f: self._pulls.pop(oid, None))
         return await task
+
+    # ---------------------------------------- dependency-prefetching dispatch
+    def _pin_for_pull(self, oid: str):
+        """Pull-manager pin hook: an object being eagerly pulled must not be
+        spilled/evicted out from under the landing bytes."""
+        meta = self.objects.get(oid)
+        if meta is not None:
+            meta.pinned += 1
+
+    def _unpin_for_pull(self, oid: str):
+        meta = self.objects.get(oid)
+        if meta is not None and meta.pinned > 0:
+            meta.pinned -= 1
+
+    def _prefetch_worthwhile(self, spec: TaskSpec, meta: ObjectMeta) -> bool:
+        """Would an eager HEAD-side pull of this remote arg help this task?
+        Locality-aware placement (compute moves to data) stays the first
+        choice: pull only when the task is bound for the head while its
+        bytes sit on a node that cannot host it. A false positive costs one
+        early transfer; dispatch stays correct either way."""
+        if self.cluster is None or not meta.location.startswith("remote:"):
+            return False
+        if spec.placement_group_id:
+            return False  # the bundle's node decides; its agent pulls deps
+        if spec.actor_id and not spec.is_actor_creation:
+            actor = self.actors.get(spec.actor_id)
+            # methods follow their actor; node_id None = hosted on the head
+            return actor is not None and actor.node_id is None
+        if spec.is_actor_creation:
+            return False  # creation placement resolves in the scheduler
+        if spec.num_returns == "streaming":
+            return True  # generators always run on the head
+        holder = meta.location.split(":", 1)[1]
+        strat = spec.scheduling_strategy
+        node_id = getattr(strat, "node_id", None)
+        if node_id and not getattr(strat, "locality_hint", False):
+            return node_id == self.node_id  # user pin: pull only if to head
+        node = self.cluster.nodes.get(holder)
+        if node is None or not node.alive:
+            return True  # holder going away: grab the bytes while we can
+        # the holder lacks a resource KEY the task needs (e.g. a head-only
+        # marker resource): placement must move the task off the data's node
+        needed = [k for k, v in spec.resources.items() if v > 0]
+        if all(k in node.resources for k in needed):
+            return False  # can run where the data is: locality wins
+        # ...but only pull to the HEAD if no other alive node could host it
+        # either (a node-to-node move rides the direct data plane instead,
+        # and a head-side copy would just stage bytes nobody dispatches on)
+        for other in self.cluster.nodes.values():
+            if (other is not node and other.alive
+                    and all(k in other.resources for k in needed)):
+                return False
+        return True
+
+    def _prefetch_request(self, oid: str):
+        """Start (or join) an eager pull of a remote object the dispatcher
+        wants head-local. Fire-and-forget: success lands the bytes through
+        the normal ingest path (which resolves gated waiters); failure
+        resolves them too, so the task dispatches anyway and its worker
+        falls back to the blocking exec-time fetch (a miss, not an error)."""
+        if self.prefetch is None or not prefetch_enabled():
+            return
+        meta = self.objects.get(oid)
+        if meta is None or not meta.location.startswith("remote:"):
+            return
+
+        async def fetch():
+            ok = False
+            try:
+                ok = bool(await self._pull_remote(oid))
+            finally:
+                m = self.objects.get(oid)
+                if ok and m is not None and m.location in ("shm", "inline"):
+                    m.prefetched = True
+                if not ok:
+                    self._resolve_dep(oid)
+            return ok
+
+        self.prefetch.request(oid, meta.size, fetch)
+
+    def _gate_on_prefetch(self, rec: TaskRecord) -> bool:
+        """Ready-arg accounting at dispatch time: a head-bound task whose
+        remote ref args have an eager pull in flight goes back to
+        PENDING_DEPS until the bytes land, keeping the worker slot free and
+        letting the exec frame ship a zero-copy descriptor instead of a
+        blocking fetch. Each arg gates at most once (prefetch_tried), so a
+        failed pull degrades to the legacy exec-time path on re-enqueue."""
+        if self.cluster is None or not prefetch_enabled():
+            return False
+        gated = False
+        for kind, v in list(rec.spec.args) + list(rec.spec.kwargs.values()):
+            if kind != "ref" or v in rec.prefetch_tried:
+                continue
+            meta = self.objects.get(v)
+            if meta is None or not meta.location.startswith("remote:"):
+                continue
+            rec.prefetch_tried.add(v)
+            rec.deps_remaining.add(v)
+            self.dep_waiters[v].add(rec.spec.task_id)
+            self._prefetch_request(v)
+            gated = True
+        if gated:
+            rec.state = PENDING_DEPS
+        return gated
+
+    def _arg_descriptors(self, rec: TaskRecord) -> Dict[str, tuple]:
+        """Per-arg descriptors for every locally resident ref arg, shipped in
+        the exec frame so the worker materializes zero-copy from the shared
+        store instead of a blocking round trip. Dispatch-time ready-arg
+        accounting: resident → prefetch_hits, anything the worker must fetch
+        at exec time → prefetch_misses; the wall time of pulls that landed
+        before dispatch accrues to prefetch_overlap_saved_ms."""
+        from ..util import metrics
+        descs: Dict[str, tuple] = {}
+        hits = misses = 0
+        saved_ms = 0.0
+        seen: Set[str] = set()
+        for kind, v in list(rec.spec.args) + list(rec.spec.kwargs.values()):
+            if kind != "ref" or v in seen:
+                continue
+            seen.add(v)
+            meta = self.objects.get(v)
+            d = None
+            if meta is not None and meta.error is None:
+                if meta.location == "spilled":
+                    try:
+                        self._ensure_local(v)
+                    except Exception:  # noqa: BLE001 - spill file gone:
+                        pass           # worker-side fetch reconstructs
+                if meta.location == "inline":
+                    d = ("inline", meta.inline_value)
+                elif meta.location == "shm":
+                    d = ("shm", meta.meta_len)
+            if d is None:
+                misses += 1
+                continue
+            descs[v] = d
+            hits += 1
+            if meta.prefetched:
+                meta.prefetched = False  # credit each pull once
+                if self.prefetch is not None:
+                    saved_ms += self.prefetch.durations_ms.pop(v, 0.0)
+        if hits:
+            metrics.get_or_create(metrics.Counter, "prefetch_hits").inc(hits)
+        if misses:
+            metrics.get_or_create(metrics.Counter, "prefetch_misses").inc(misses)
+        if saved_ms:
+            metrics.get_or_create(
+                metrics.Counter, "prefetch_overlap_saved_ms").inc(saved_ms)
+        return descs
 
     def _resolve_dep(self, oid: str):
         for tid in self.dep_waiters.pop(oid, ()):

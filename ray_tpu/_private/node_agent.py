@@ -40,7 +40,7 @@ from typing import Dict, Optional
 from .. import exceptions as exc
 from . import ids, paths, protocol
 from .cluster import HEARTBEAT_S, cluster_token
-from .controller import Controller, DEFAULT_CAPACITY
+from .controller import Controller, DEFAULT_CAPACITY, prefetch_max_bytes
 from .task_spec import ObjectMeta, TaskSpec
 
 
@@ -230,6 +230,98 @@ def _record_transfer(nbytes: int, nstreams: int, seconds: float,
     metrics.get_or_create(metrics.Histogram, "transfer_fetch_seconds",
                           boundaries=[0.001, 0.01, 0.1, 1, 10, 100]
                           ).observe(seconds)
+
+
+class PullManager:
+    """Eager dependency pulls: single-flight per object id with an in-flight
+    byte cap (ref: ray src/ray/object_manager/pull_manager.cc admission +
+    dedup). `request(oid, size, fetch)` launches `fetch` — a zero-arg
+    callable returning an awaitable that is truthy on success — as a loop
+    task and returns it; a second request for an in-flight oid returns the
+    SAME task (requesters join one transfer). Requests that would push
+    in-flight bytes over the cap park FIFO and launch as completions free
+    room (request returns None for those — admission is backpressure, not
+    rejection). pin/unpin hooks bracket every pull so the landing object
+    can't be spilled or evicted mid-transfer, and `durations_ms` holds each
+    completed pull's wall time until a dispatcher claims it for overlap
+    accounting."""
+
+    def __init__(self, loop, max_bytes: int = 256 << 20,
+                 pin=None, unpin=None):
+        self.loop = loop
+        self.max_bytes = max(1, int(max_bytes))
+        self.inflight_bytes = 0
+        self.durations_ms: Dict[str, float] = {}
+        self._inflight: Dict[str, asyncio.Task] = {}
+        self._waiting = []          # FIFO of (oid, size, fetch) over the cap
+        self._queued: set = set()   # oids parked in _waiting
+        self._pin = pin
+        self._unpin = unpin
+
+    def request(self, oid: str, size: int, fetch) -> Optional[asyncio.Task]:
+        from ..util import metrics
+        size = int(size or 0)
+        t = self._inflight.get(oid)
+        if t is not None:
+            metrics.get_or_create(metrics.Counter,
+                                  "prefetch_pull_dedup").inc()
+            return t
+        if oid in self._queued:
+            metrics.get_or_create(metrics.Counter,
+                                  "prefetch_pull_dedup").inc()
+            return None
+        if self.inflight_bytes and self.inflight_bytes + size > self.max_bytes:
+            self._queued.add(oid)
+            self._waiting.append((oid, size, fetch))
+            return None
+        return self._launch(oid, size, fetch)
+
+    def _launch(self, oid: str, size: int, fetch) -> asyncio.Task:
+        from ..util import metrics
+        metrics.get_or_create(metrics.Counter, "prefetch_pulls").inc()
+        if size:
+            metrics.get_or_create(metrics.Counter,
+                                  "prefetch_pull_bytes").inc(size)
+        self.inflight_bytes += size
+        if self._pin is not None:
+            self._pin(oid)
+        t0 = time.monotonic()
+
+        async def run():
+            ok = False
+            try:
+                ok = bool(await fetch())
+            except Exception:  # noqa: BLE001 - a failed eager pull is a
+                ok = False     # dispatch miss, never a task error
+            finally:
+                self.inflight_bytes -= size
+                self._inflight.pop(oid, None)
+                if self._unpin is not None:
+                    self._unpin(oid)
+                if ok:
+                    self.durations_ms[oid] = (time.monotonic() - t0) * 1e3
+                    while len(self.durations_ms) > 4096:  # unclaimed: bound
+                        self.durations_ms.pop(next(iter(self.durations_ms)))
+                else:
+                    metrics.get_or_create(metrics.Counter,
+                                          "prefetch_pull_failures").inc()
+                self._drain()
+            return ok
+
+        t = self.loop.create_task(run())
+        self._inflight[oid] = t
+        return t
+
+    def _drain(self):
+        while self._waiting:
+            oid, size, fetch = self._waiting[0]
+            if (self.inflight_bytes
+                    and self.inflight_bytes + size > self.max_bytes):
+                return
+            self._waiting.pop(0)
+            self._queued.discard(oid)
+            if oid not in self._inflight:
+                self._launch(oid, size, fetch)
 
 
 class ObjectDataServer:
@@ -521,7 +613,26 @@ class NodeAgent:
         self.data_server = ObjectDataServer(controller)
         self.last_fwd_seq = 0       # highest fwd_task seq processed (stats)
         self.direct_pull_bytes = 0  # data-plane counters (stats → head)
-        self._redirect_pulls: set = set()  # oids with a direct pull in flight
+        self._pull_manager: Optional[PullManager] = None  # built on first use
+                                                          # (needs the loop)
+
+    @property
+    def pull_manager(self) -> PullManager:
+        if self._pull_manager is None:
+            self._pull_manager = PullManager(
+                self.c.loop, max_bytes=prefetch_max_bytes(),
+                pin=self._pin_obj, unpin=self._unpin_obj)
+        return self._pull_manager
+
+    def _pin_obj(self, oid: str):
+        meta = self.c.objects.get(oid)
+        if meta is not None:
+            meta.pinned += 1
+
+    def _unpin_obj(self, oid: str):
+        meta = self.c.objects.get(oid)
+        if meta is not None and meta.pinned > 0:
+            meta.pinned -= 1
 
     # ------------------------------------------------------------ lifecycle
     async def run(self):
@@ -650,10 +761,11 @@ class NodeAgent:
                 if meta.location != "pending":
                     meta.location = "pending"
                     self.c.object_events[oid].clear()
-                if oid not in self._redirect_pulls:
-                    # dedupe: N tasks sharing the dep = ONE transfer
-                    self._redirect_pulls.add(oid)
-                    self.c.loop.create_task(self._direct_pull(d))
+                # single-flight via the pull manager: N tasks sharing the
+                # dep = ONE transfer, byte-capped alongside eager pulls
+                self.pull_manager.request(
+                    oid, d.get("size") or 0,
+                    lambda d=d: self._direct_pull(d))
             else:
                 self.c._ingest_bytes(oid, d)
             oids.append(oid)
@@ -684,37 +796,38 @@ class NodeAgent:
             payload = await direct_fetch(d["addr"], oid, timeout=timeout)
         return payload
 
-    async def _direct_pull(self, d: dict):
+    async def _direct_pull(self, d: dict) -> bool:
         """Pull a redirected dep straight from its owner's data server;
         fall back to a head-staged fetch if the owner is gone/evicted, and
         surface ObjectLostError if both fail (same contract as
-        _pull_uplink)."""
+        _pull_uplink). Runs under the pull manager, which keeps the oid
+        in-flight until this returns — a task arriving mid-pull can never
+        spawn a duplicate transfer."""
         oid = d["oid"]
         try:
             payload = await self._fetch_direct(d)
-            if payload is not None:
-                self.direct_pull_bytes += payload["size"]
-                self.c._ingest_bytes(oid, payload)
-                self._holds(oid)
-                return
+        except Exception:  # noqa: BLE001 - dead peer: try the head instead
+            payload = None
+        if payload is not None:
+            self.direct_pull_bytes += payload["size"]
+            self.c._ingest_bytes(oid, payload)
+            self._holds(oid)
+            return True
+        ok = False
+        try:
+            ok = await self.fetch_object(oid, no_redirect=True)
+        except Exception:  # noqa: BLE001 - uplink hiccup = not found
             ok = False
-            try:
-                ok = await self.fetch_object(oid, no_redirect=True)
-            except Exception:  # noqa: BLE001 - uplink hiccup = not found
-                ok = False
-            if not ok:
-                meta = self.c.objects.get(oid)
-                if meta is not None and meta.location == "pending":
-                    meta.error = exc.ObjectLostError(oid)
-                    meta.location = "error"
-                    ev = self.c.object_events.get(oid)
-                    if ev is not None:
-                        ev.set()
-                    self.c._resolve_dep(oid)
-        finally:
-            # cleared only once the oid is ingested or marked error, so a
-            # task arriving mid-pull can never spawn a duplicate transfer
-            self._redirect_pulls.discard(oid)
+        if not ok:
+            meta = self.c.objects.get(oid)
+            if meta is not None and meta.location == "pending":
+                meta.error = exc.ObjectLostError(oid)
+                meta.location = "error"
+                ev = self.c.object_events.get(oid)
+                if ev is not None:
+                    ev.set()
+                self.c._resolve_dep(oid)
+        return bool(ok)
 
     async def _on_fwd_task(self, p: dict):
         spec: TaskSpec = p["spec"]

@@ -279,6 +279,17 @@ class StoreClient:
         shm.close()
         return data
 
+    def warm(self, object_id: str, meta_len: int) -> bool:
+        """Best-effort lookahead materialization: attach + deserialize so a
+        later get() of the same object hits the per-process cache and the
+        pages are warm. Never raises — a vanished segment just returns
+        False (the caller's exec-time fallback will handle it)."""
+        try:
+            self.get(object_id, meta_len)
+            return True
+        except Exception:  # noqa: BLE001 - advisory only
+            return False
+
     def release(self, object_id: str):
         loc = self._attached.pop(object_id, None)
         if isinstance(loc, weakref.ref):

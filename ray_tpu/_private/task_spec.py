@@ -59,7 +59,8 @@ class ActorCreationOptions:
 @dataclass
 class ObjectMeta:
     """Controller-side object table entry (ref: src/ray/gcs object table +
-    plasma entry). location: 'shm' | 'inline' | 'spilled'."""
+    plasma entry). location: 'pending' | 'shm' | 'inline' | 'spilled' |
+    'remote:<node_id>' (bytes authoritative in that node's store)."""
 
     object_id: str
     size: int = 0
@@ -78,3 +79,6 @@ class ObjectMeta:
     # hold a copy — extra sources for multi-peer parallel fetch. Best-effort:
     # a stale holder just MISSes and the fetch redistributes.
     holders: List[str] = field(default_factory=list)
+    # the local copy landed via an eager dependency pull (dispatch credits
+    # the pull's wall time to prefetch_overlap_saved_ms on first hit)
+    prefetched: bool = False

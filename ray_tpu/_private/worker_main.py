@@ -66,17 +66,56 @@ def _load_fn(ws, blob):
     return fn
 
 
-def _resolve_args(ws, spec):
-    """Fetch top-level ObjectRef args (values inline; nested refs stay refs)."""
+def _resolve_args(ws, spec, arg_descs=None):
+    """Fetch top-level ObjectRef args (values inline; nested refs stay refs).
+
+    `arg_descs` (dependency-prefetching dispatch) carries descriptors for
+    args already resident in the shared local store: those materialize
+    zero-copy here instead of through a blocking round trip. A descriptor
+    that fails to materialize (segment vanished under us — holder death or
+    eviction mid-prefetch) falls back to one blocking get with the rest, so
+    a stale descriptor can never fail the task."""
     ref_oids = [v for k, v in list(spec.args) + list(spec.kwargs.values()) if k == "ref"]
     fetched = {}
-    if ref_oids:
-        values = ws.client.get(ref_oids)
-        fetched = dict(zip(ref_oids, values))
+    missing = []
+    for oid in dict.fromkeys(ref_oids):
+        d = (arg_descs or {}).get(oid)
+        if d is None:
+            missing.append(oid)
+            continue
+        try:
+            kind, payload = d
+            if kind == "inline":
+                fetched[oid] = serialization.unpack(payload)
+            else:  # ("shm", meta_len): zero-copy from the shared store
+                fetched[oid] = ws.client.store.get(oid, payload)
+        except Exception:  # noqa: BLE001 - stale descriptor → exec-time fetch
+            missing.append(oid)
+    if missing:
+        values = ws.client.get(missing)
+        fetched.update(zip(missing, values))
     args = [fetched[v] if k == "ref" else serialization.unpack(v) for k, v in spec.args]
     kwargs = {name: (fetched[v] if k == "ref" else serialization.unpack(v))
               for name, (k, v) in spec.kwargs.items()}
     return args, kwargs
+
+
+def _warm_next(ws):
+    """Lookahead resolution: while the pool computes task N, touch the shm
+    segments of queued task N+1 so its _resolve_args is a warm zero-copy
+    attach (the dispatch loop is otherwise idle between exec frames).
+    Purely advisory — a vanished segment is task N+1's fallback problem."""
+    try:
+        with ws.client.task_available:
+            nxt = (ws.client.task_queue[0]
+                   if ws.client.task_queue else None)
+        if not nxt:
+            return
+        for oid, d in (nxt.get("arg_descs") or {}).items():
+            if d and d[0] == "shm":
+                ws.client.store.warm(oid, d[1])
+    except Exception:  # noqa: BLE001 - warming must never hurt dispatch
+        pass
 
 
 def _call(ws, fn, args, kwargs):
@@ -113,7 +152,7 @@ def _execute(ws, p):
     error = None
     results = []
     try:
-        args, kwargs = _resolve_args(ws, spec)
+        args, kwargs = _resolve_args(ws, spec, p.get("arg_descs"))
         if spec.is_actor_creation:
             cls = _load_fn(ws, spec.fn_blob)
             ws.actor_instance = cls(*args, **kwargs)
@@ -147,7 +186,9 @@ def _execute(ws, p):
         error = exc.TaskError(spec.name or str(spec.method_name or "task"), tb, e)
     finally:
         ws.client.current_task_id = None
-    ws.client._send("task_done", task_id=spec.task_id, results=results, error=error)
+    # fire-and-forget: rides the ordered batch flusher behind this task's
+    # puts (legacy direct frame when prefetching dispatch is off)
+    ws.client.send_task_done(spec.task_id, results, error)
 
 
 def _drain_generator(ws, spec, handle_oid, gen):
@@ -200,7 +241,15 @@ def main():
     state.set_global_client(client)
     ws = WorkerState(client)
     state.set_worker_state(ws)
-    pool = ThreadPoolExecutor(max_workers=64, thread_name_prefix="rtpu-exec")
+    # actors declare their real parallelism; plain-task workers keep the
+    # old 64-thread ceiling (the controller's CPU accounting is the real cap)
+    try:
+        max_workers = max(1, int(os.environ.get("RAY_TPU_MAX_CONCURRENCY",
+                                                "64")))
+    except ValueError:
+        max_workers = 64
+    pool = ThreadPoolExecutor(max_workers=max_workers,
+                              thread_name_prefix="rtpu-exec")
     while True:
         with client.task_available:
             while not client.task_queue:
@@ -209,6 +258,7 @@ def main():
         if p is None:
             break
         pool.submit(_execute, ws, p)
+        _warm_next(ws)
     pool.shutdown(wait=True)
     # drain any still-buffered refcount deltas before dropping the socket
     # (best effort: if the controller is already gone the flush is a no-op)

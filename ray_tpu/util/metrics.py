@@ -207,6 +207,42 @@ def transfer_bytes_total() -> int:
     return int(_counter_total("transfer_fetch_bytes"))
 
 
+def prefetch_counters() -> Dict[str, float]:
+    """Dependency-prefetching dispatch tallies (per process — the head sees
+    its own dispatches, each node agent its own). hits/misses are counted
+    at DISPATCH: a hit means a ref arg was shm/inline-resident when the
+    exec frame shipped (the worker resolves it zero-copy); a miss means
+    the worker had to fall back to the blocking exec-time fetch.
+    pulls/pull_bytes/dedup/failures tally the eager pull manager;
+    overlap_saved_ms sums the pull wall-time of args that were prefetched
+    and hit — transfer time taken off the task critical path."""
+    return {"hits": _counter_total("prefetch_hits"),
+            "misses": _counter_total("prefetch_misses"),
+            "pulls": _counter_total("prefetch_pulls"),
+            "pull_bytes": _counter_total("prefetch_pull_bytes"),
+            "dedup": _counter_total("prefetch_pull_dedup"),
+            "failures": _counter_total("prefetch_pull_failures"),
+            "overlap_saved_ms": _counter_total("prefetch_overlap_saved_ms")}
+
+
+def prefetch_hit_rate() -> float:
+    """hits / (hits + misses); 1.0 when nothing was ever dispatched with
+    ref args (nothing was ever missed)."""
+    c = prefetch_counters()
+    total = c["hits"] + c["misses"]
+    return 1.0 if total == 0 else c["hits"] / total
+
+
+def result_async_counters() -> Dict[str, float]:
+    """Fire-and-forget task-result publication tallies, counted where the
+    batched `task_done` entries are APPLIED (the controller process):
+    tasks whose completion rode a batch frame, result objects registered
+    that way, and their inline bytes."""
+    return {"tasks": _counter_total("result_async_tasks"),
+            "results": _counter_total("result_async_results"),
+            "bytes": _counter_total("result_async_bytes")}
+
+
 def sched_locality_counters() -> Dict[str, float]:
     """Locality-aware placement tallies (head process): hits = tasks placed
     on the node already holding the most arg bytes, misses = arg bytes
