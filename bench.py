@@ -374,6 +374,31 @@ def _parse_json_tail(stdout: str):
     return None
 
 
+def observability_snapshot():
+    """Point-in-time observability state for embedding in a measure child's
+    JSON record (perf numbers ship with the metrics + trace state that
+    produced them, so a regression's artifact shows WHERE the time went,
+    not just that it went). Metric tag-tuples flatten to "k=v,..." strings
+    — the raw snapshot keys aren't JSON keys. Never raises — a snapshot
+    must not sink a measured number."""
+    try:
+        from ray_tpu.util import metrics, tracing
+        lbl = lambda k: ",".join(f"{a}={b}" for a, b in k) or "_"
+        flat = []
+        for m in metrics.collect():
+            rec = {"name": m["name"], "type": m["type"]}
+            if m["type"] in ("counter", "gauge"):
+                rec["values"] = {lbl(k): v for k, v in m["values"].items()}
+            else:  # histogram: count + sum carry the signal; buckets don't
+                rec["count"] = {lbl(k): v for k, v in m["count"].items()}
+                rec["sum"] = {lbl(k): round(v, 6)
+                              for k, v in m["sum"].items()}
+            flat.append(rec)
+        return {"metrics": flat, "tracing": tracing.summary()}
+    except Exception as e:  # noqa: BLE001
+        return {"error": str(e)}
+
+
 def _write_result_artifact(tag, record):
     """Persist a successful measure-child record under benchmarks/results/
     as <tag>_<UTC timestamp>.json, committed with the round's PR — perf
@@ -741,6 +766,7 @@ def measure(config_name):
         "attn": cfg.attn_impl,
         "strict_flash": bool(os.environ.get("RAY_TPU_STRICT_FLASH")),
         "fresh_batches": True,
+        "observability": observability_snapshot(),
     }))
 
 

@@ -116,8 +116,43 @@ def run_mode(sync: bool, n: int, fanout_m: int):
         ray_tpu.shutdown()
 
 
+def _set_trace(on: bool):
+    """Flip tracing for the NEXT init cycle: the env var is what spawned
+    workers inherit; refresh() re-reads it for this (driver) process."""
+    os.environ["RAY_TPU_TRACE"] = "1" if on else "0"
+    from ray_tpu.util import tracing
+    tracing.refresh()
+
+
+def trace_overhead(n: int, reps: int = 2):
+    """Submit-latency cost of span annotation: pipelined mode with tracing
+    forced ON vs OFF, interleaved off/on reps, best-of-reps p50 each (the
+    min discards scheduler-noise outliers — the signal is a sub-µs adder).
+    Restores the ambient RAY_TPU_TRACE afterwards."""
+    prev = os.environ.get("RAY_TPU_TRACE")
+    p50 = {False: [], True: []}
+    try:
+        for _ in range(reps):
+            for on in (False, True):
+                _set_trace(on)
+                p50[on].append(
+                    run_mode(sync=False, n=n, fanout_m=4)["submit_p50_us"])
+    finally:
+        if prev is None:
+            os.environ.pop("RAY_TPU_TRACE", None)
+        else:
+            os.environ["RAY_TPU_TRACE"] = prev
+        from ray_tpu.util import tracing
+        tracing.refresh()
+    off, on = min(p50[False]), min(p50[True])
+    return {"n": n, "reps": reps,
+            "submit_p50_off_us": off, "submit_p50_on_us": on,
+            "p50_off_all_us": p50[False], "p50_on_all_us": p50[True],
+            "overhead_ratio": round(on / max(off, 1e-9), 3)}
+
+
 def measure():
-    from bench import _INIT_SENTINEL  # repo root on sys.path (line 36)
+    from bench import _INIT_SENTINEL, observability_snapshot  # repo root on sys.path
     # no jax import here — the control plane can't wedge on a backend, so
     # the watchdog sentinel goes out immediately
     print(f"{_INIT_SENTINEL} backend=control-plane", file=sys.stderr,
@@ -136,6 +171,8 @@ def measure():
     out["speedup_e2e"] = round(
         out["pipelined"]["e2e_tps"] / max(out["blocking"]["e2e_tps"],
                                           1e-9), 2)
+    out["tracing_overhead"] = trace_overhead(N, reps=2)
+    out["observability"] = observability_snapshot()
     print(json.dumps(out))
 
 
@@ -151,6 +188,15 @@ def smoke():
     assert rec["fanout"]["submit_rt"] <= 1, (
         f"worker fanout submit cost {rec['fanout']['submit_rt']} round "
         f"trips (expected ≤ 1)")
+    # tracing-overhead invariant (ISSUE 6): span annotation on the submit
+    # hot path must cost < 5% of submit p50. The 2 µs absolute grace keeps
+    # a sub-30 µs baseline from failing on timer quantization alone — 5%
+    # of 19 µs is under one scheduler tick on a loaded CI box.
+    ov = trace_overhead(n=max(n * 4, 128), reps=2)
+    off, on_ = ov["submit_p50_off_us"], ov["submit_p50_on_us"]
+    assert on_ <= max(off * 1.05, off + 2.0), (
+        f"tracing overhead too high: p50 {off} -> {on_} us ({ov})")
+    rec["tracing_overhead"] = ov
     print(json.dumps({"bench": "core_control_plane_smoke", **rec}))
 
 

@@ -18,6 +18,7 @@ reference-count RPCs, core_worker.cc / reference_count.cc):
   overtake the put that created its ref.
 """
 
+import collections
 import concurrent.futures
 import os
 import socket
@@ -26,11 +27,51 @@ import time
 import asyncio
 
 from .. import exceptions as exc
+from ..util import tracing
 from . import ids, protocol, serialization
 from .object_store import StoreClient
 from .task_spec import TaskSpec
 
 _INLINE_MAX = 64 * 1024
+
+# first-return-oid -> trace id, ONLY for refs whose trace was inherited
+# from the surrounding context (nested submits, driver spans) — a root
+# task's trace id IS its task id, re-derivable from the oid, so the hot
+# path stores nothing. Bounded FIFO so an un-got ref can't grow it
+# without limit.
+_REF_TRACE_CAP = 4096
+_ref_traces = collections.OrderedDict()
+_ref_traces_lock = threading.Lock()
+
+
+# submit hot path: trace ids are DERIVED from the task id (no mint, no
+# registry write) — any process holding the task id recomputes the same
+# id and sampling verdict. Returns the trace id only when it was
+# inherited from the thread-local context (nested submits), the one case
+# the caller must _note_ref_trace.
+_annotate_trace = tracing.stamp
+
+
+def _note_ref_trace(oid: str, trace_id):
+    if trace_id is None:
+        return
+    with _ref_traces_lock:
+        _ref_traces[oid] = trace_id
+        while len(_ref_traces) > _REF_TRACE_CAP:
+            _ref_traces.popitem(last=False)
+
+
+def _ref_trace(oid: str):
+    with _ref_traces_lock:
+        tid = _ref_traces.get(oid)
+    if tid is not None:
+        return tid
+    # root-task refs: obj-{task_id}-ret{i} — re-derive instead of storing
+    if oid.startswith("obj-"):
+        cut = oid.rfind("-ret")
+        if cut > 4:
+            return tracing.trace_id_for(oid[4:cut])
+    return None
 
 # flush when a batch accumulates this many entries / inline-put bytes, or
 # when the short timer fires — whichever comes first
@@ -283,11 +324,15 @@ class DriverClient(BaseClient):
 
     # -- api surface --------------------------------------------------------
     def submit(self, spec: TaskSpec):
+        inherited = _annotate_trace(spec)
         if not self._pipelined:
-            return self._call(self.controller.submit(spec))
+            oids = self._call(self.controller.submit(spec))
+            _note_ref_trace(oids[0], inherited)
+            return oids
         n = (1 if spec.num_returns == "streaming"
              else max(spec.num_returns, 1))
         oids = [ids.object_id_for_return(spec.task_id, i) for i in range(n)]
+        _note_ref_trace(oids[0], inherited)
         ctl = self.controller
         with self._flusher.lock:
             # fuse pending deltas with the submit into ONE loop callback:
@@ -306,13 +351,20 @@ class DriverClient(BaseClient):
         return oids
 
     def get(self, oids, timeout=None):
+        t0 = time.time() if tracing.enabled() else 0.0
         # dedup before the fetch: a get([r, r, ...]) waits/pulls each unique
         # object once, then fans the descriptors back out in caller order
         uniq = list(dict.fromkeys(oids))
         descs = self._call(self.controller.get_descriptors(uniq, timeout),
                            timeout=None if timeout is None else timeout + 5)
         by_oid = dict(zip(uniq, descs))
-        return self._materialize(oids, [by_oid[o] for o in oids])
+        out = self._materialize(oids, [by_oid[o] for o in oids])
+        if t0:
+            tracing.record_span(
+                "client.get", "client", _ref_trace(oids[0]) if oids else None,
+                tracing.new_span_id(), None, t0, time.time() - t0,
+                args={"n": len(oids)})
+        return out
 
     def put(self, value):
         oid = ids.object_id()
@@ -423,7 +475,9 @@ class DriverClient(BaseClient):
         return out
 
     def timeline(self):
-        return self._call_soon(lambda: list(self.controller.timeline_events))
+        from .controller import format_timeline
+        return self._call_soon(
+            lambda: format_timeline(self.controller.timeline_events))
 
 
 class WorkerClient(BaseClient):
@@ -564,11 +618,16 @@ class WorkerClient(BaseClient):
 
     # -- api surface --------------------------------------------------------
     def submit(self, spec: TaskSpec):
+        # nested tasks inherit the exec thread's trace
+        inherited = _annotate_trace(spec)
         if not self._pipelined:
-            return self._rpc("submit", spec=spec)["refs"]
+            oids = self._rpc("submit", spec=spec)["refs"]
+            _note_ref_trace(oids[0], inherited)
+            return oids
         n = (1 if spec.num_returns == "streaming"
              else max(spec.num_returns, 1))
         oids = [ids.object_id_for_return(spec.task_id, i) for i in range(n)]
+        _note_ref_trace(oids[0], inherited)
         # fire-and-forget; _send flushes first, so the spec can never
         # overtake the put registrations of its own arguments
         self._send("submit_async", spec=spec, result_oids=oids)
@@ -629,21 +688,25 @@ class WorkerClient(BaseClient):
         meta_len, size, inline, contained = self._encode_to_store(oid, value)
         return (oid, meta_len, size, inline, contained)
 
-    def send_task_done(self, task_id, results, error):
+    def send_task_done(self, task_id, results, error, span=None):
         """Publish a task's completion. With prefetching dispatch on, the
         entry rides the ordered batch flusher (fire-and-forget: the exec
         thread is free for the next task without awaiting application, and
         since every blocking RPC force-flushes first, a later decref can
         never be applied before this publication — put-before-decref holds
-        transitively). Legacy mode keeps the direct ordered frame."""
+        transitively). Legacy mode keeps the direct ordered frame.
+
+        `span` is the worker-side timing tuple (resolve start, exec start,
+        exec end — epoch seconds) the controller folds into the task's
+        phase spans; None when tracing is off/unsampled."""
         if self._pipelined and _prefetch_enabled():
             # urgent: the flusher timer skips its coalescing nap — callers
             # may already be blocked in ray.get() on these results
-            self._flusher.append(("task_done", task_id, results, error),
+            self._flusher.append(("task_done", task_id, results, error, span),
                                  urgent=True)
         else:
             self._send("task_done", task_id=task_id, results=results,
-                       error=error)
+                       error=error, span=span)
 
     def wait(self, oids, num_returns, timeout):
         tid = self.current_task_id

@@ -245,6 +245,11 @@ class ClusterServer:
             node.direct_pull_bytes = p.get("direct_pull_bytes", 0)
             node.direct_serve_bytes = p.get("direct_serve_bytes", 0)
             node.last_seen = time.time()
+            # traced spans shipped from the node (fire-and-forget batches)
+            # merge into the head's timeline; pid was stamped node-side so
+            # Perfetto groups them per process
+            for ev in p.get("spans") or ():
+                c.timeline_events.append(ev)
             c._schedule()
         elif kind == "resp":
             fut = self._reqs.pop(p.pop("req_id"), None)
@@ -611,6 +616,9 @@ class ClusterServer:
             return
         for r in p["results"]:
             c._ingest_result(r, node.node_id)
+        if p.get("phases"):
+            rec.phases = p["phases"]  # node controller's phase durations
+        rec.ts_end = rec.ts_end or time.time()
         rec.state = "DONE"
         rec.done.set()
         c._mark_task_terminal(rec)

@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from .. import exceptions as exc
+from ..util import tracing
 from . import ids, protocol
 from .object_store import StoreClient
 from .runtime_env import runtime_env_key
@@ -43,6 +44,34 @@ A_DEAD = "DEAD"
 
 _INLINE_MAX = 64 * 1024
 DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_STORE_BYTES", 8 << 30))
+
+
+def format_timeline(entries) -> List[dict]:
+    """Expand the timeline ring into Chrome trace_event dicts. The
+    completion hot path appends raw tuples (one per task); the dict +
+    f-string cost per phase is paid here, at query/ship time. Entries
+    that are already dicts (spans shipped from nodes, pre-formatted by
+    the agent) pass through unchanged."""
+    out: List[dict] = []
+    for e in entries:
+        if isinstance(e, dict):
+            out.append(e)
+        elif e[0] == "_task":
+            _, name, w_tid, t0, t1, trace_id, task_id = e
+            ev = {"name": name, "ph": "X", "pid": 1, "tid": w_tid,
+                  "ts": t0 * 1e6, "dur": max(t1 - t0, 1e-6) * 1e6}
+            if trace_id is not None:
+                ev["args"] = {"trace_id": trace_id, "task_id": task_id}
+            out.append(ev)
+        elif e[0] == "_phases":
+            _, name, w_tid, trace_id, task_id, windows = e
+            for phase, a, b in windows:
+                out.append({"name": f"{name}:{phase}", "cat": "task_phase",
+                            "ph": "X", "pid": 1, "tid": w_tid, "ts": a * 1e6,
+                            "dur": max(b - a, 1e-6) * 1e6,
+                            "args": {"trace_id": trace_id, "task_id": task_id,
+                                     "phase": phase}})
+    return out
 
 
 def prefetch_enabled() -> bool:
@@ -89,6 +118,12 @@ class TaskRecord:
     # each arg gates at most once, so a failed pull degrades to the legacy
     # exec-time fetch instead of re-gating forever
     prefetch_tried: Set[str] = field(default_factory=set)
+    # tracing (util.tracing): eager-pull wall windows [(t0, t1)] claimed at
+    # dispatch for this task's args; worker-reported (resolve, exec-start,
+    # exec-end) epoch stamps; derived per-phase durations for the state API
+    prefetch_windows: List[tuple] = field(default_factory=list)
+    worker_span: Optional[tuple] = None
+    phases: Optional[Dict[str, float]] = None
 
 
 class _ReadyIndex:
@@ -404,6 +439,10 @@ class Controller:
         self.lineage_specs: "collections.OrderedDict[str, tuple]" = collections.OrderedDict()
         self.timeline_events: collections.deque = collections.deque(
             maxlen=int(os.environ.get("RAY_TPU_TIMELINE_RETENTION", "20000")))
+        # node controllers (span_ship=True, set by NodeAgent) copy traced
+        # phase spans here; the agent's heartbeat drains them to the head
+        self.span_ship = False
+        self.span_outbox: List[dict] = []
         # runtime_env builder (py_modules/pip/working_dir staging, hash-cached)
         from .runtime_env import RuntimeEnvManager
         self.runtime_envs = RuntimeEnvManager()
@@ -622,7 +661,7 @@ class Controller:
             except ValueError as e:
                 self._reply(w, p["req_id"], error=e)
         elif kind == "timeline":
-            self._reply(w, p["req_id"], events=list(self.timeline_events))
+            self._reply(w, p["req_id"], events=format_timeline(self.timeline_events))
         elif kind == "create_pg":
             self.loop.create_task(self._worker_create_pg(w, p))
         elif kind == "remove_pg":
@@ -734,7 +773,9 @@ class Controller:
                         metrics.get_or_create(
                             metrics.Counter, "result_async_bytes").inc(nbytes)
                 self._on_task_done(
-                    w, {"task_id": e[1], "results": results, "error": e[3]})
+                    w, {"task_id": e[1], "results": results, "error": e[3],
+                        # older 4-tuple entries carry no worker span stamps
+                        "span": e[4] if len(e) > 4 else None})
 
     def apply_batch_local(self, entries):
         """Driver-side batch: same entries, no per-worker tally (driver refs
@@ -1414,6 +1455,8 @@ class Controller:
         wid = ids.worker_id()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = wid
+        # joins worker log records to traces (logging_config.ContextFilter)
+        env["RAY_TPU_NODE_ID"] = self.node_id
         # Propagate the driver's sys.path so by-reference cloudpickle (module
         # -level fns/classes) resolves in workers even when the driver added
         # path entries at runtime (pytest rootdir insertion, scripts mutating
@@ -1516,9 +1559,11 @@ class Controller:
             self._schedule()
             return
         rec.ts_end = time.time()
-        self.timeline_events.append({
-            "name": rec.spec.name or task_id, "ph": "X", "pid": 1, "tid": w.pid or 1,
-            "ts": rec.ts_start * 1e6, "dur": max(rec.ts_end - rec.ts_start, 1e-6) * 1e6})
+        # raw tuple; formatted lazily at timeline query (format_timeline)
+        self.timeline_events.append(
+            ("_task", rec.spec.name or task_id, w.pid or 1, rec.ts_start,
+             rec.ts_end, rec.spec.trace_id, task_id))
+        self._record_task_spans(rec, w.pid or 1, p.get("span"))
         spec = rec.spec
         actor = self.actors.get(spec.actor_id) if spec.actor_id else None
         if actor is not None and not spec.is_actor_creation:
@@ -1568,6 +1613,63 @@ class Controller:
         self._schedule()
         if actor is not None and actor.pending_gc:
             self._maybe_gc_actor(actor)
+
+    def _record_task_spans(self, rec: TaskRecord, tid, wspan):
+        """Derive the task's per-phase spans at completion:
+
+          queued   = submit -> dispatch (dep wait + queue + gate)
+          prefetch = eager-pull wall window(s) claimed for its args
+                     (overlaps `queued` by design — that IS the overlap the
+                     pull manager buys; never extends past dispatch)
+          exec     = dispatch -> worker-reported exec end
+          publish  = worker exec end -> completion applied here (the
+                     fire-and-forget result path: flusher batch + transit)
+
+        Durations land on rec.phases (state API); for traced tasks ONE
+        raw tuple lands on timeline_events (and on span_outbox when this
+        controller is a node — the agent's heartbeat ships them to the
+        head). Formatting into Chrome "X" events — dict + f-string per
+        phase — happens lazily at query/ship time (format_timeline): this
+        runs on the completion hot path, in the loop thread that shares
+        the GIL with submitting drivers. `wspan` is the worker's
+        (resolve_t0, exec_t0, exec_t1) epoch stamps; the worker and this
+        controller share a host (unix socket), so the clocks are
+        comparable."""
+        rec.worker_span = wspan
+        t_sub = rec.ts_submit or rec.ts_start
+        t_start, t_end = rec.ts_start, rec.ts_end
+        exec_end = t_end
+        if wspan:
+            try:
+                exec_end = min(max(float(wspan[2]), t_start), t_end)
+            except (TypeError, IndexError, ValueError):
+                exec_end = t_end
+        phases = {"queued": max(t_start - t_sub, 0.0),
+                  "exec": max(exec_end - t_start, 0.0),
+                  "publish": max(t_end - exec_end, 0.0)}
+        pw = rec.prefetch_windows
+        if pw:
+            p0 = min(a for a, _ in pw)
+            p1 = max(b for _, b in pw)
+            p1 = min(p1, t_start)  # gated pulls land before dispatch
+            p0 = min(p0, p1)
+            phases["prefetch"] = max(p1 - p0, 0.0)
+        rec.phases = phases
+        trace_id = rec.spec.trace_id
+        if trace_id is None or not tracing.enabled():
+            return
+        windows = [("queued", t_sub, t_start), ("exec", t_start, exec_end),
+                   ("publish", exec_end, t_end)]
+        if pw:
+            windows.insert(1, ("prefetch", p0, p1))
+        entry = ("_phases", rec.spec.name or rec.spec.task_id, tid,
+                 trace_id, rec.spec.task_id, windows)
+        self.timeline_events.append(entry)
+        if getattr(self, "span_ship", False):
+            outbox = self.span_outbox
+            outbox.append(entry)
+            if len(outbox) > 20000:
+                del outbox[:len(outbox) - 20000]
 
     def _release_task_resources(self, rec: TaskRecord):
         if rec.spec.actor_id:
@@ -1930,6 +2032,16 @@ class Controller:
                 meta.prefetched = False  # credit each pull once
                 if self.prefetch is not None:
                     saved_ms += self.prefetch.durations_ms.pop(v, 0.0)
+            if self.prefetch is not None:
+                # trace window claimed on existence, NOT meta.prefetched: a
+                # gated task dispatches in the same loop turn the pull's
+                # ingest resolves its deps — before the pull coroutine's
+                # finally stamps prefetched/duration. The open window (end
+                # None) is closed at claim time: the bytes landed this turn
+                win = self.prefetch.windows.pop(v, None)
+                if win is not None:
+                    rec.prefetch_windows.append(
+                        (win[0], win[1] if win[1] is not None else time.time()))
         if hits:
             metrics.get_or_create(metrics.Counter, "prefetch_hits").inc(hits)
         if misses:
@@ -2888,7 +3000,9 @@ class Controller:
             # are the ones a `list_tasks()` right after a submit must surface
             return [{"task_id": t.spec.task_id, "name": t.spec.name, "state": t.state,
                      "worker_id": t.worker_id,
-                     "duration_s": (t.ts_end - t.ts_start) if t.ts_end else None}
+                     "duration_s": (t.ts_end - t.ts_start) if t.ts_end else None,
+                     "trace_id": t.spec.trace_id,
+                     "phases": t.phases}
                     for t in sorted(self.tasks.values(),
                                     key=lambda t: t.ts_submit, reverse=True)]
         if kind == "objects":
@@ -2916,4 +3030,10 @@ class Controller:
             return [{"pg_id": pg.pg_id, "name": pg.name, "strategy": pg.strategy,
                      "bundles": [dict(b.resources) for b in pg.bundles]}
                     for pg in self.pgroups.values()]
+        if kind == "metrics":
+            # this process's util.metrics registry — the controller process
+            # holds the scheduler/prefetch/transfer series, so remote
+            # surfaces (dashboard actor) scrape through here
+            from ..util import metrics
+            return metrics.collect()
         raise ValueError(f"unknown state kind {kind}")

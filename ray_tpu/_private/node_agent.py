@@ -38,9 +38,11 @@ import time
 from typing import Dict, Optional
 
 from .. import exceptions as exc
+from ..util import tracing
 from . import ids, paths, protocol
 from .cluster import HEARTBEAT_S, cluster_token
-from .controller import Controller, DEFAULT_CAPACITY, prefetch_max_bytes
+from .controller import (Controller, DEFAULT_CAPACITY, format_timeline,
+                         prefetch_max_bytes)
 from .task_spec import ObjectMeta, TaskSpec
 
 
@@ -252,6 +254,9 @@ class PullManager:
         self.max_bytes = max(1, int(max_bytes))
         self.inflight_bytes = 0
         self.durations_ms: Dict[str, float] = {}
+        # completed-pull wall windows (epoch t0, t1) per oid, claimed at
+        # dispatch into the task's prefetch phase span (util.tracing)
+        self.windows: Dict[str, tuple] = {}
         self._inflight: Dict[str, asyncio.Task] = {}
         self._waiting = []          # FIFO of (oid, size, fetch) over the cap
         self._queued: set = set()   # oids parked in _waiting
@@ -286,6 +291,13 @@ class PullManager:
         if self._pin is not None:
             self._pin(oid)
         t0 = time.monotonic()
+        # trace span: open the wall window NOW — a gated task can dispatch
+        # in the very loop turn the pull's ingest resolves its deps, before
+        # this coroutine's finally runs, and the claimer (the controller's
+        # _arg_descriptors) closes an open window itself
+        self.windows[oid] = (time.time(), None)
+        while len(self.windows) > 4096:  # unclaimed windows: bound memory
+            self.windows.pop(next(iter(self.windows)))
 
         async def run():
             ok = False
@@ -302,9 +314,13 @@ class PullManager:
                     self.durations_ms[oid] = (time.monotonic() - t0) * 1e3
                     while len(self.durations_ms) > 4096:  # unclaimed: bound
                         self.durations_ms.pop(next(iter(self.durations_ms)))
+                    win = self.windows.get(oid)
+                    if win is not None and win[1] is None:  # not yet claimed
+                        self.windows[oid] = (win[0], time.time())
                 else:
                     metrics.get_or_create(metrics.Counter,
                                           "prefetch_pull_failures").inc()
+                    self.windows.pop(oid, None)  # no bytes: no trace span
                 self._drain()
             return ok
 
@@ -613,6 +629,10 @@ class NodeAgent:
         self.data_server = ObjectDataServer(controller)
         self.last_fwd_seq = 0       # highest fwd_task seq processed (stats)
         self.direct_pull_bytes = 0  # data-plane counters (stats → head)
+        # traced phase spans from the node controller collect in its
+        # span_outbox; the heartbeat drains them to the head (fire-and-
+        # forget, ordering not required — Chrome events carry their own ts)
+        controller.span_ship = True
         self._pull_manager: Optional[PullManager] = None  # built on first use
                                                           # (needs the loop)
 
@@ -671,6 +691,18 @@ class NodeAgent:
         while not self.c._shutdown:
             await asyncio.sleep(HEARTBEAT_S)
             try:
+                # span shipping piggybacks on the heartbeat: drain this
+                # node's traced phase spans (node-id-stamped pid groups
+                # them per process in Perfetto) plus the agent process's
+                # own tracing ring, capped per beat so a burst can't bloat
+                # one frame — leftovers ride the next beat
+                raw = self.c.span_outbox[:500]  # raw tuples, ~4 events each
+                del self.c.span_outbox[:len(raw)]
+                spans = format_timeline(raw)
+                spans += tracing.to_chrome(tracing.drain(500))
+                pid = os.getpid()
+                for ev in spans:
+                    ev["pid"] = pid
                 protocol.awrite_msg(
                     self.writer, "stats",
                     available=dict(self.c.available),
@@ -679,7 +711,8 @@ class NodeAgent:
                     # head re-debit claims this snapshot can't reflect yet
                     fwd_seq=self.last_fwd_seq,
                     direct_pull_bytes=self.direct_pull_bytes,
-                    direct_serve_bytes=self.data_server.serve_bytes)
+                    direct_serve_bytes=self.data_server.serve_bytes,
+                    spans=spans)
             except OSError:
                 return
 
@@ -881,8 +914,11 @@ class NodeAgent:
                                 task_id=rec.spec.task_id, error=error,
                                 results=[])
         else:
+            # phases computed by the node controller at completion ride up
+            # so the head's state API covers forwarded tasks too
             protocol.awrite_msg(self.writer, "task_result",
-                                task_id=rec.spec.task_id, results=results)
+                                task_id=rec.spec.task_id, results=results,
+                                phases=rec.phases)
         if dep_oids:
             # drop this task's hold on its shipped dep copies (pins taken by
             # submit are already released; _evict guards on pinned)

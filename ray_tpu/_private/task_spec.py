@@ -39,6 +39,12 @@ class TaskSpec:
     # provenance
     parent_task_id: Optional[str] = None
     job_id: Optional[str] = None
+    # tracing context (util.tracing): trace_id is None when tracing is off
+    # or this trace was not sampled — every downstream hop keys off that.
+    # parent_span_id is the submitting side's span (nested tasks chain to
+    # their parent task's exec span).
+    trace_id: Optional[str] = None
+    parent_span_id: Optional[int] = None
     # ObjectRef ids serialized *inside* inline arg values (not top-level ref
     # args); the controller pins them for the task's lifetime like ref args
     nested_refs: List[str] = field(default_factory=list)

@@ -13,12 +13,14 @@ import inspect
 import os
 import sys
 import threading
+import time
 import traceback
 from concurrent.futures import ThreadPoolExecutor
 
 import cloudpickle
 
 from .. import exceptions as exc
+from ..util import tracing
 from . import ids, serialization, state
 from .client import WorkerClient
 
@@ -111,9 +113,18 @@ def _warm_next(ws):
                    if ws.client.task_queue else None)
         if not nxt:
             return
+        t0 = time.time()
+        warmed = 0
         for oid, d in (nxt.get("arg_descs") or {}).items():
             if d and d[0] == "shm":
                 ws.client.store.warm(oid, d[1])
+                warmed += 1
+        if warmed and tracing.enabled():
+            nspec = nxt.get("spec")
+            tracing.record_span(
+                "worker.warm_next", "worker",
+                getattr(nspec, "trace_id", None), tracing.new_span_id(),
+                None, t0, time.time() - t0, args={"args_warmed": warmed})
     except Exception:  # noqa: BLE001 - warming must never hurt dispatch
         pass
 
@@ -149,10 +160,18 @@ def _execute(ws, p):
     result_oids = p["result_oids"]
     ws.client.current_task_id = spec.task_id
     ws.current.spec = spec
+    # thread-local trace context: nested submits from this task inherit
+    # the trace, log records pick up trace_id, and the stamps below let
+    # the controller split exec from publish in the task's phase spans
+    traced = spec.trace_id is not None and tracing.enabled()
+    if traced:
+        tracing.set_current(spec.trace_id, spec.parent_span_id)
+    t_res0 = t_exec0 = time.time()
     error = None
     results = []
     try:
         args, kwargs = _resolve_args(ws, spec, p.get("arg_descs"))
+        t_exec0 = time.time()
         if spec.is_actor_creation:
             cls = _load_fn(ws, spec.fn_blob)
             ws.actor_instance = cls(*args, **kwargs)
@@ -186,9 +205,26 @@ def _execute(ws, p):
         error = exc.TaskError(spec.name or str(spec.method_name or "task"), tb, e)
     finally:
         ws.client.current_task_id = None
+        if traced:
+            tracing.set_current(None, None)
+    t_done = time.time()
+    span = None
+    if traced:
+        # (resolve start, exec start, exec end): the controller folds these
+        # into the task's exec/publish phase spans; the local ring keeps a
+        # worker-side copy for per-process debugging
+        span = (t_res0, t_exec0, t_done)
+        tracing.record_span("worker.resolve_args", "worker", spec.trace_id,
+                            tracing.new_span_id(), spec.parent_span_id,
+                            t_res0, t_exec0 - t_res0,
+                            args={"task_id": spec.task_id})
+        tracing.record_span("worker.exec", "worker", spec.trace_id,
+                            tracing.new_span_id(), spec.parent_span_id,
+                            t_exec0, t_done - t_exec0,
+                            args={"task_id": spec.task_id})
     # fire-and-forget: rides the ordered batch flusher behind this task's
     # puts (legacy direct frame when prefetching dispatch is off)
-    ws.client.send_task_done(spec.task_id, results, error)
+    ws.client.send_task_done(spec.task_id, results, error, span)
 
 
 def _drain_generator(ws, spec, handle_oid, gen):

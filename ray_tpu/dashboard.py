@@ -9,6 +9,8 @@ http://...`) and any browser/curl share this one surface:
   GET  /api/version            build + session info
   GET  /api/cluster_status     resources + store usage
   GET  /api/nodes|actors|tasks|objects|workers    state-API snapshots
+  GET  /api/metrics | /metrics Prometheus text exposition (all registries)
+  GET  /api/timeline           Chrome trace_event JSON (Perfetto-loadable)
   GET  /api/jobs/              list jobs
   POST /api/jobs/              {entrypoint, submission_id?, runtime_env?, metadata?}
   GET  /api/jobs/{id}          job info
@@ -103,19 +105,24 @@ class DashboardActor:
             return _coerce_response(client.state(path.rsplit("/", 1)[-1]))
         if path == "/api/autoscaler":
             return _coerce_response(client.autoscaler_status())
-        if path == "/api/metrics":
-            # this process's util.metrics registry (registries are
-            # per-process, like the reference's worker-local metric agents)
-            from ray_tpu.util.metrics import collect
-            return _coerce_response(collect())
-        if path == "/metrics":
-            # Prometheus scrape endpoint: cluster-level gauges synthesized
-            # from the controller state plus this process's registry (ref:
-            # ray's metrics agent exporting over an HTTP scrape port)
-            from ray_tpu.util.metrics import collect
-            snaps = _cluster_snapshots(client) + collect()
+        if path in ("/api/metrics", "/metrics"):
+            # Prometheus text exposition of every util.metrics
+            # Counter/Gauge/Histogram: the controller process's registry
+            # (scheduler/prefetch/transfer series, fetched over the state
+            # RPC) merged with this process's, plus cluster-level gauges
+            # synthesized from controller state (ref: ray's metrics agent
+            # exporting over an HTTP scrape port)
+            snaps = _cluster_snapshots(client) + _registry_snapshots(client)
             return Response(_prometheus_text(snaps).encode(), 200,
                             media_type="text/plain; version=0.0.4")
+        if path == "/api/timeline":
+            # Chrome trace_event JSON (complete events, us timestamps) —
+            # load in Perfetto / chrome://tracing. The head aggregates
+            # phase spans from every node's heartbeat, so this is the
+            # cluster-wide task timeline.
+            events = client.timeline()
+            body = json.dumps(events).encode()
+            return Response(body, 200, media_type="application/json")
 
         if path == "/api/jobs":
             loop = asyncio.get_running_loop()
@@ -226,6 +233,20 @@ async function refresh(){
 refresh(); setInterval(refresh, 2000);
 </script></body></html>
 """
+
+
+def _registry_snapshots(client):
+    """Controller-process registry (state RPC) merged with this process's
+    own; the controller wins name collisions — it owns the shared series
+    (both processes register e.g. nothing today, but the merge keeps the
+    scrape well-formed if that changes: one TYPE block per name)."""
+    from ray_tpu.util.metrics import collect
+    try:
+        head = client.state("metrics")
+    except Exception:  # noqa: BLE001 - a scrape never fails the endpoint
+        head = []
+    seen = {m["name"] for m in head}
+    return head + [m for m in collect() if m["name"] not in seen]
 
 
 def _cluster_snapshots(client):

@@ -30,6 +30,8 @@ from typing import Callable, Iterator, List
 
 import pyarrow as pa
 
+from ray_tpu.util import tracing
+
 # Per-operator budget of unconsumed downstream bytes before dispatch pauses
 # (ref: backpressure_policy defaults). Overridable per plan.
 DEFAULT_OP_BUDGET = 128 << 20
@@ -181,6 +183,10 @@ class StreamingExecutor:
         # peak bytes parked in the store behind sampling barriers (spill-
         # managed; tracked for introspection, not gated)
         self.peak_barrier_store_bytes = 0
+        # ref -> (stage name, dispatch wall time): completed map blocks
+        # record a driver-side span (util.tracing) covering their in-flight
+        # window, so pipeline blocks land on the same timeline as tasks
+        self._block_t0 = {}
 
     # ------------------------------------------------------------- remotes
     def _remote(self, key, fn, num_returns=1):
@@ -291,6 +297,8 @@ class StreamingExecutor:
                     else:
                         out = rfn.remote(ref)
                     st.inflight[out] = idx
+                    if tracing.enabled():
+                        self._block_t0[out] = (st.name, time.time())
             else:
                 op = st.op
                 # sampling phase: draw tiny per-block samples while input
@@ -371,6 +379,13 @@ class StreamingExecutor:
                     idx = s.inflight.pop(ref)
                     s.buffer[idx] = (ref, sizes[ref])
                     s.note_out(sizes[ref])
+                    stamp = self._block_t0.pop(ref, None)
+                    if stamp is not None:
+                        tracing.record_span(
+                            f"data.block:{stamp[0]}", "data", None,
+                            tracing.new_span_id(), None, stamp[1],
+                            time.time() - stamp[1],
+                            args={"bytes": sizes[ref], "index": idx})
             else:
                 for ref in [r for r in s.sample_inflight if r in ready_set]:
                     idx = s.sample_inflight.pop(ref)

@@ -15,10 +15,48 @@ import dataclasses
 import json
 import logging
 import os
+import re
 from typing import Tuple
 
 _ENV = "RAY_TPU_LOGGING_CONFIG"
 _VALID_ENCODINGS = ("TEXT", "JSON")
+
+
+class ContextFilter(logging.Filter):
+    """Injects node_id / worker_id / trace_id into every record so worker
+    logs join to traces (util.tracing) by trace_id and to the cluster
+    topology by node/worker. Values already set on the record (a caller's
+    `extra=`) win; otherwise node/worker come from the env the spawning
+    controller published and trace_id from the exec thread's current span
+    context. Always returns True — this filter annotates, never drops."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        if not hasattr(record, "node_id"):
+            record.node_id = os.environ.get("RAY_TPU_NODE_ID", "")
+        if not hasattr(record, "worker_id"):
+            record.worker_id = os.environ.get("RAY_TPU_WORKER_ID", "driver")
+        if not hasattr(record, "trace_id"):
+            try:
+                from ray_tpu.util import tracing
+                record.trace_id = tracing.current_trace_id() or ""
+            except Exception:  # noqa: BLE001 - logging must never raise
+                record.trace_id = ""
+        return True
+
+
+class SafeFormatter(logging.Formatter):
+    """%-style formatter that tolerates records missing referenced fields
+    (a third-party logger without our filter, a record predating apply()):
+    missing attrs render as '-' instead of raising KeyError inside the
+    logging machinery and eating the message."""
+
+    _FIELD_RE = re.compile(r"%\((\w+)\)")
+
+    def format(self, record: logging.LogRecord) -> str:
+        for field in self._FIELD_RE.findall(self._fmt or ""):
+            if field not in record.__dict__ and not hasattr(record, field):
+                setattr(record, field, "-")
+        return super().format(record)
 
 
 class JsonFormatter(logging.Formatter):
@@ -36,9 +74,16 @@ class JsonFormatter(logging.Formatter):
             "name": record.name,
             "message": record.getMessage(),
         }
-        wid = os.environ.get("RAY_TPU_WORKER_ID")
+        # trace-join context: ContextFilter stamped these on the record;
+        # fall back to the env so a filter-less handler still gets ids
+        wid = getattr(record, "worker_id",
+                      os.environ.get("RAY_TPU_WORKER_ID"))
         if wid:
             out["worker_id"] = wid
+        for attr in ("node_id", "trace_id"):
+            v = getattr(record, attr, None)
+            if v:
+                out[attr] = v
         for attr in self.additional:
             out[attr] = getattr(record, attr, None)
         if record.exc_info:
@@ -82,8 +127,11 @@ class LoggingConfig:
             return JsonFormatter(self.additional_log_standard_attrs)
         wid = os.environ.get("RAY_TPU_WORKER_ID")
         prefix = f"({wid}) " if wid else ""
-        return logging.Formatter(
-            prefix + "%(asctime)s %(levelname)s %(name)s: %(message)s")
+        # SafeFormatter: %(trace_id)s renders "-" on records that bypassed
+        # ContextFilter instead of raising inside the logging machinery
+        return SafeFormatter(
+            prefix + "%(asctime)s %(levelname)s %(name)s "
+            "[trace=%(trace_id)s]: %(message)s")
 
     def apply(self):
         """Install on the root logger (idempotent: replaces a previously
@@ -94,6 +142,7 @@ class LoggingConfig:
                 root.removeHandler(h)
         handler = logging.StreamHandler()
         handler._ray_tpu_logging = True
+        handler.addFilter(ContextFilter())
         handler.setFormatter(self._formatter())
         handler.setLevel(self.log_level)
         root.addHandler(handler)

@@ -521,6 +521,15 @@ class LLMServer:
         if tokens:
             self._m_tokens.inc(tokens)
         self._m_chunk_ms.observe(dt_s * 1e3)
+        from ray_tpu.util import tracing
+        if tracing.enabled():
+            # one span per device round trip — the decode timeline shows
+            # chunked ticks (N tokens / sync) next to the task spans
+            tracing.record_span(
+                "serve.decode_chunk", "serve", tracing.current_trace_id(),
+                tracing.new_span_id(), None, time.time() - dt_s, dt_s,
+                args={"tokens": tokens, "chunk": chunk,
+                      "batch": len(self._active)})
 
     def reconfigure(self, user_config: Optional[Dict[str, Any]]):
         """Serve `user_config` hook (replica.py calls this at deployment

@@ -10,7 +10,13 @@ import bisect
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
-_registry_lock = threading.Lock()
+# Reentrant: get_or_create holds it across construction and
+# Metric.__init__ re-acquires to register — the whole check-then-create is
+# one critical section, so two racing threads can't build duplicate
+# instances of the same series and clear_registry() can't interleave
+# between the lookup and the construction (which used to resurrect a
+# cleared counter mid-test).
+_registry_lock = threading.RLock()
 _registry: Dict[str, "Metric"] = {}
 
 
@@ -120,16 +126,22 @@ def get_or_create(metric_cls, name: str, *args, **kwargs) -> "Metric":
     forks the series when several instances of a component (e.g. every
     LLMServer replica in one process) each build their own — shared series
     must go through here. Raises TypeError if `name` is already registered
-    as a different metric class."""
+    as a different metric class.
+
+    Thread-safe end to end: the lookup AND the construction happen under
+    the (reentrant) registry lock, so concurrent callers get the same
+    instance and a concurrent clear_registry() either beats the whole
+    operation or waits for it — it can no longer land between the check
+    and the create."""
     with _registry_lock:
         existing = _registry.get(name)
-    if existing is not None:
-        if not isinstance(existing, metric_cls):
-            raise TypeError(
-                f"metric {name!r} already registered as "
-                f"{type(existing).__name__}, not {metric_cls.__name__}")
-        return existing
-    return metric_cls(name, *args, **kwargs)
+        if existing is not None:
+            if not isinstance(existing, metric_cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as "
+                    f"{type(existing).__name__}, not {metric_cls.__name__}")
+            return existing
+        return metric_cls(name, *args, **kwargs)
 
 
 def collect() -> List[Dict]:

@@ -33,6 +33,18 @@ def list_tasks(filters=None, limit: int = 100) -> List[Dict]:
     return _filtered(_snapshot("tasks"), filters)[:limit]
 
 
+def get_task(task_id: str) -> Optional[Dict]:
+    """One task's row, including its trace context and per-phase durations
+    once it completed: ``phases={queued, prefetch, exec, publish}`` in
+    seconds (``prefetch`` only when an eager pull ran for its args; None
+    while the task is still in flight). Forwarded tasks carry the phases
+    computed by their node's controller."""
+    for row in _snapshot("tasks"):
+        if row.get("task_id") == task_id:
+            return row
+    return None
+
+
 def list_objects(filters=None, limit: int = 100) -> List[Dict]:
     return _filtered(_snapshot("objects"), filters)[:limit]
 

@@ -1,0 +1,282 @@
+"""Cluster-wide task tracing: spans with trace/span/parent ids in a
+per-process bounded ring buffer.
+
+Every process (driver, head controller thread, node agent, worker) keeps
+its own ring; span context travels inside existing frames (TaskSpec
+fields, task_done batch entries, node heartbeat "stats" frames) so one
+``trace_id`` follows a task through
+
+    client.submit -> controller schedule/place -> PullManager prefetch
+    -> dispatch gate -> worker resolve/exec/warm -> result publish
+    -> client.get
+
+Hot-path budget: submit p50 is ~19us, so recording must stay well under
+1us. That dictates the design here:
+
+  * ``record_span`` appends ONE tuple to a deque — no dict building, no
+    string formatting, no isoformat. Formatting is lazy (``events()``).
+  * ids are a cached process prefix + integer counter, not uuid4.
+  * ``enabled()`` is a cached module bool (re-read via ``refresh()``),
+    so the disabled path is a single global load.
+  * sampling (``RAY_TPU_TRACE_SAMPLE``, default 1.0) is decided ONCE at
+    trace creation, deterministically from the trace id (crc32), so all
+    processes agree per-trace with zero coordination. An unsampled
+    submit ships ``trace_id=None`` downstream — zero cost past the
+    sample check.
+
+Timestamps: span *durations* come from monotonic-adjacent measurement at
+the recording site; the stored ``ts`` is ``time.time()`` so spans from
+different processes land on one comparable timeline (the Chrome trace
+axis). Within one host — the loopback-cluster case — ``time.time()`` is
+the same clock everywhere.
+
+Env knobs:
+  RAY_TPU_TRACE         "0" disables tracing entirely (default: on)
+  RAY_TPU_TRACE_SAMPLE  fraction of traces recorded (default 1.0)
+  RAY_TPU_TRACE_BUFFER  per-process ring capacity in spans (default 65536)
+"""
+
+from __future__ import annotations
+
+import itertools
+import os
+import threading
+import time
+import zlib
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = [
+    "enabled", "sample_rate", "refresh", "new_trace_id", "new_span_id",
+    "trace_id_for", "stamp", "record_span", "span", "set_current",
+    "get_current", "current_trace_id", "events", "drain", "clear",
+    "to_chrome", "summary", "set_process_label",
+]
+
+_lock = threading.Lock()
+
+_enabled: bool = True
+_sample: float = 1.0
+_buf: deque = deque(maxlen=65536)
+_dropped: int = 0
+# next(_count) is a single C-level op under the GIL — no lock on the id path
+_count = itertools.count(1)
+_id_prefix: str = ""
+_process_label: str = ""
+
+# per-thread current span context: (trace_id, span_id) — set by the worker
+# around task execution so nested submits and log records inherit it
+_ctx = threading.local()
+
+
+def refresh() -> None:
+    """Re-read the env knobs (process start, tests, bench mode flips)."""
+    global _enabled, _sample, _buf, _dropped, _id_prefix
+    with _lock:
+        _enabled = os.environ.get("RAY_TPU_TRACE", "1") not in ("0", "false")
+        try:
+            _sample = float(os.environ.get("RAY_TPU_TRACE_SAMPLE", "1.0"))
+        except ValueError:
+            _sample = 1.0
+        try:
+            cap = int(os.environ.get("RAY_TPU_TRACE_BUFFER", "65536"))
+        except ValueError:
+            cap = 65536
+        cap = max(16, cap)
+        if _buf.maxlen != cap:
+            _buf = deque(_buf, maxlen=cap)
+        _id_prefix = f"{os.getpid():x}-"
+
+
+def trace_id_for(key: str) -> Optional[str]:
+    """Sampled trace id DERIVED from an already-unique key (a task id):
+    the key itself is the id, so the submit hot path neither mints nor
+    stores anything — any process holding the key re-derives the same
+    id AND the same sampling verdict. At the default sample rate this is
+    two global loads and a compare."""
+    if not _enabled:
+        return None
+    if _sample >= 1.0:
+        return key
+    if _sample <= 0.0:
+        return None
+    if (zlib.crc32(key.encode()) % 10000) < int(_sample * 10000):
+        return key
+    return None
+
+
+refresh()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def sample_rate() -> float:
+    return _sample
+
+
+def set_process_label(label: str) -> None:
+    """Human name for this process in Chrome traces ("driver", "node:x")."""
+    global _process_label
+    _process_label = label
+
+
+def stamp(spec) -> Optional[str]:
+    """Stamp trace context onto an outgoing TaskSpec — THE submit hot
+    path, hence one cross-module call doing everything inline. The trace
+    id is derived from the task id (no mint, no registry write); nested
+    submits inherit the surrounding task's trace from the thread-local.
+    Returns the trace id ONLY in that inherited case — the one case the
+    caller must note a ref->trace mapping (a derived id needs none)."""
+    if not _enabled:
+        return None
+    tid, psid = getattr(_ctx, "trace", (None, None))
+    if tid is None:
+        if _sample >= 1.0:
+            spec.trace_id = spec.task_id
+        elif _sample > 0.0:
+            spec.trace_id = trace_id_for(spec.task_id)
+        return None
+    spec.trace_id = tid
+    spec.parent_span_id = psid
+    return tid
+
+
+def new_trace_id() -> Optional[str]:
+    """Mint a fresh trace id (root spans with no natural key — serve
+    requests, data pipelines), or None when this trace is not sampled."""
+    if not _enabled:
+        return None
+    return trace_id_for(_id_prefix + format(next(_count), "x"))
+
+
+def new_span_id() -> int:
+    return next(_count)
+
+
+def set_current(trace_id: Optional[str], span_id: Optional[int]) -> None:
+    _ctx.trace = (trace_id, span_id)
+
+
+def get_current() -> Tuple[Optional[str], Optional[int]]:
+    return getattr(_ctx, "trace", (None, None))
+
+
+def current_trace_id() -> Optional[str]:
+    return getattr(_ctx, "trace", (None, None))[0]
+
+
+def record_span(name: str, cat: str, trace_id: Optional[str],
+                span_id: Optional[int], parent_id: Optional[int],
+                ts: float, dur: float,
+                tid: Any = 0, args: Optional[dict] = None) -> None:
+    """Append one completed span. ``ts`` is epoch seconds, ``dur`` seconds.
+
+    Raw tuples only — formatting happens in ``events()``/``to_chrome()``.
+    """
+    global _dropped
+    if not _enabled:
+        return
+    buf = _buf
+    if len(buf) == buf.maxlen:
+        _dropped += 1
+    buf.append((name, cat, trace_id, span_id, parent_id, ts, dur, tid, args))
+
+
+@contextmanager
+def span(name: str, cat: str = "app", trace_id: Optional[str] = None,
+         parent_id: Optional[int] = None, tid: Any = 0,
+         args: Optional[dict] = None):
+    """Context manager for non-hot paths (serve ticks, data blocks)."""
+    if not _enabled:
+        yield None
+        return
+    if trace_id is None:
+        trace_id, cur = get_current()
+        if parent_id is None:
+            parent_id = cur
+    sid = new_span_id()
+    t0 = time.time()
+    m0 = time.monotonic()
+    try:
+        yield sid
+    finally:
+        record_span(name, cat, trace_id, sid, parent_id, t0,
+                    time.monotonic() - m0, tid=tid, args=args)
+
+
+def _format(raw) -> Dict[str, Any]:
+    name, cat, trace_id, span_id, parent_id, ts, dur, tid, args = raw
+    d: Dict[str, Any] = {"name": name, "cat": cat, "ts": ts, "dur": dur,
+                         "pid": os.getpid(), "tid": tid}
+    if trace_id is not None:
+        d["trace_id"] = trace_id
+    if span_id is not None:
+        d["span_id"] = span_id
+    if parent_id is not None:
+        d["parent_id"] = parent_id
+    if args:
+        d["args"] = dict(args)
+    return d
+
+
+def events() -> List[Dict[str, Any]]:
+    """Formatted copy of the ring (does not clear)."""
+    with _lock:
+        raw = list(_buf)
+    return [_format(r) for r in raw]
+
+
+def drain(max_n: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Pop up to ``max_n`` oldest spans, formatted. Used by span shippers
+    (node heartbeat) so each span is forwarded exactly once."""
+    out = []
+    with _lock:
+        n = len(_buf) if max_n is None else min(max_n, len(_buf))
+        for _ in range(n):
+            out.append(_buf.popleft())
+    return [_format(r) for r in out]
+
+
+def clear() -> None:
+    global _dropped
+    with _lock:
+        _buf.clear()
+        _dropped = 0
+    if hasattr(_ctx, "trace"):
+        _ctx.trace = (None, None)
+
+
+def to_chrome(evts: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    """Convert formatted span dicts (ts/dur in SECONDS) to Chrome
+    ``trace_event`` complete ("X") events (ts/dur in MICROSECONDS) —
+    loadable in Perfetto / chrome://tracing."""
+    out = []
+    for e in evts:
+        ev = {"name": e.get("name", "?"), "cat": e.get("cat", "app"),
+              "ph": "X", "pid": e.get("pid", 1), "tid": e.get("tid", 0),
+              "ts": e["ts"] * 1e6, "dur": max(e.get("dur", 0.0), 1e-6) * 1e6}
+        ar = dict(e.get("args") or {})
+        for k in ("trace_id", "span_id", "parent_id"):
+            if k in e:
+                ar[k] = e[k]
+        if ar:
+            ev["args"] = ar
+        out.append(ev)
+    if _process_label:
+        out.append({"name": "process_name", "ph": "M", "pid": os.getpid(),
+                    "tid": 0, "args": {"name": _process_label}})
+    return out
+
+
+def summary() -> Dict[str, Any]:
+    """Cheap per-process health snapshot for bench records."""
+    with _lock:
+        n = len(_buf)
+        cats: Dict[str, int] = {}
+        for r in _buf:
+            cats[r[1]] = cats.get(r[1], 0) + 1
+    return {"enabled": _enabled, "sample": _sample, "spans": n,
+            "dropped": _dropped, "by_cat": cats}

@@ -29,3 +29,17 @@ def ray_session():
     ray_tpu.init(num_cpus=4, ignore_reinit_error=True)
     yield ray_tpu
     ray_tpu.shutdown()
+
+
+@pytest.fixture(autouse=True)
+def _reset_observability():
+    """Isolate tracing state between tests: a test that flips RAY_TPU_TRACE*
+    or fills the span ring must not leak into the next one. The metrics
+    registry is intentionally NOT cleared here — session-scoped components
+    (controller, dashboard) hold live Metric objects across tests and
+    clear_registry() would orphan them; tests that need a clean registry
+    call clear_registry() themselves."""
+    yield
+    from ray_tpu.util import tracing
+    tracing.clear()
+    tracing.refresh()

@@ -1,0 +1,136 @@
+"""Dashboard observability HTTP surface: /api/metrics scrape, /api/timeline
+Chrome trace export, and malformed-request handling (ISSUE 6 satellite;
+ref: python/ray/dashboard REST routes + metrics agent scrape port)."""
+
+import json
+import socket
+import urllib.error
+import urllib.request
+
+import pytest
+
+
+@pytest.fixture(scope="module")
+def dash(ray_session):
+    from ray_tpu.dashboard import start_dashboard
+    _actor, port = start_dashboard(port=0)
+    return ray_session, f"http://127.0.0.1:{port}"
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=30) as r:
+        return r.headers, r.read()
+
+
+def test_metrics_prometheus_exposition(dash):
+    """Every util.metrics series renders as well-formed Prometheus text:
+    one TYPE line per metric, counter/gauge samples, histogram buckets
+    with cumulative counts and a +Inf terminator."""
+    ray, base = dash
+    ray.get(ray.remote(lambda: 1).remote())  # touch the control plane
+
+    hdrs, body = _get(base, "/api/metrics")
+    assert hdrs["Content-Type"].startswith("text/plain")
+    text = body.decode()
+
+    # cluster gauges synthesized from controller state
+    assert "# TYPE ray_tpu_workers gauge" in text
+    assert "ray_tpu_resource_total{resource=\"CPU\"}" in text
+    # controller-registry series fetched over the state RPC: the head
+    # counts async result applications, so a completed task must show up
+    assert "# TYPE result_async_tasks counter" in text
+
+    # structural invariants: every sample line's metric name has a TYPE
+    typed = {ln.split()[2] for ln in text.splitlines()
+             if ln.startswith("# TYPE")}
+    for ln in text.splitlines():
+        if not ln or ln.startswith("#"):
+            continue
+        name = ln.split("{")[0].split()[0]
+        base_name = name
+        for suf in ("_bucket", "_sum", "_count"):
+            if name.endswith(suf) and name[:-len(suf)] in typed:
+                base_name = name[:-len(suf)]
+        assert base_name in typed, f"sample without TYPE: {ln}"
+
+    # /metrics is an alias of /api/metrics
+    _, body2 = _get(base, "/metrics")
+    assert b"# TYPE ray_tpu_workers gauge" in body2
+
+
+def test_timeline_chrome_trace(dash):
+    """/api/timeline returns Chrome trace_event JSON: per-task phase spans
+    ("X" events, microsecond ts/dur) carrying the derived trace id."""
+    ray, base = dash
+
+    @ray.remote
+    def traced(x):
+        return x + 1
+
+    refs = [traced.remote(i) for i in range(4)]
+    assert ray.get(refs) == [1, 2, 3, 4]
+
+    hdrs, body = _get(base, "/api/timeline")
+    assert hdrs["Content-Type"].startswith("application/json")
+    events = json.loads(body)
+    assert isinstance(events, list)
+
+    phase_evs = [e for e in events if e.get("cat") == "task_phase"]
+    assert phase_evs, "no task_phase events in the timeline"
+    by_task = {}
+    for e in phase_evs:
+        assert e["ph"] == "X" and "ts" in e and e["dur"] >= 0
+        args = e["args"]
+        assert args["trace_id"] and args["task_id"]
+        by_task.setdefault(args["task_id"], set()).add(args["phase"])
+    # at least one completed task shows the full queued/exec/publish split
+    assert any({"queued", "exec", "publish"} <= ph
+               for ph in by_task.values()), by_task
+    # default sampling derives the trace id from the task id itself
+    assert any(e["args"]["trace_id"] == e["args"]["task_id"]
+               for e in phase_evs)
+
+
+def test_task_state_rows_carry_phases(dash):
+    """The state API surfaces per-task phase durations (get_task parity)."""
+    ray, base = dash
+    ray.get(ray.remote(lambda: "ok").remote())
+    _, body = _get(base, "/api/tasks")
+    rows = json.loads(body)
+    done = [r for r in rows if r.get("phases")]
+    assert done, rows[:3]
+    ph = done[0]["phases"]
+    assert {"queued", "exec", "publish"} <= set(ph)
+    assert all(v >= 0 for v in ph.values())
+
+
+def test_unknown_route_is_404_json(dash):
+    _, base = dash
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/api/nonsense")
+    assert ei.value.code == 404
+    assert "no route" in json.loads(ei.value.read())["error"]
+
+
+def test_bad_job_body_is_400(dash):
+    _, base = dash
+    req = urllib.request.Request(
+        base + "/api/jobs/", data=b"{not json", method="POST",
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 400
+    assert "invalid JSON" in json.loads(ei.value.read())["error"]
+
+
+def test_malformed_http_request_is_400(dash):
+    """A parseable request line with a garbage Content-Length must produce
+    a 400, not a hung connection or a traceback page."""
+    _, base = dash
+    host, port = base[len("http://"):].split(":")
+    with socket.create_connection((host, int(port)), timeout=30) as s:
+        s.sendall(b"GET /api/version HTTP/1.1\r\n"
+                  b"Content-Length: banana\r\n\r\n")
+        s.settimeout(30)
+        data = s.recv(4096)
+    assert data.startswith(b"HTTP/1.1 400"), data[:200]

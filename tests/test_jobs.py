@@ -148,8 +148,12 @@ def test_dashboard_metrics_and_autoscaler_endpoints(ray_session):
     assert "# TYPE ray_tpu_workers gauge" in text
     assert "ray_tpu_object_store_capacity_bytes " in text
 
+    # /api/metrics serves the same Prometheus text exposition as /metrics
+    # (every util.metrics series, controller registry merged in)
     with urllib.request.urlopen(base + "/api/metrics", timeout=30) as r:
-        assert isinstance(json.loads(r.read()), list)
+        assert r.headers["Content-Type"].startswith("text/plain")
+        api_text = r.read().decode()
+    assert "# TYPE ray_tpu_workers gauge" in api_text
 
     with urllib.request.urlopen(base + "/api/autoscaler", timeout=30) as r:
         auto = json.loads(r.read())
