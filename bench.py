@@ -394,7 +394,18 @@ def observability_snapshot():
                 rec["sum"] = {lbl(k): round(v, 6)
                               for k, v in m["sum"].items()}
             flat.append(rec)
-        return {"metrics": flat, "tracing": tracing.summary()}
+        out = {"metrics": flat, "tracing": tracing.summary()}
+        # cluster health rides along when a session is live: BENCH_* JSONs
+        # then carry store/queue state and any alerts the round fired
+        try:
+            from ray_tpu._private import state as _state
+            client = _state.global_client_or_none()
+            if client is not None:
+                out["cluster"] = client.state("cluster_health")
+                out["alerts"] = client.state("alerts")
+        except Exception:  # noqa: BLE001
+            pass
+        return out
     except Exception as e:  # noqa: BLE001
         return {"error": str(e)}
 

@@ -34,6 +34,10 @@ Modes:
               chain task shows disjoint prefetch/exec/publish phases, task
               N+1's prefetch overlaps task N's exec, and phase durations
               cover >= 90% of per-task wall time
+  --chaos     health-plane acceptance run (ISSUE 11): kills the worker node
+              mid-run and asserts /api/cluster + /api/alerts visibility,
+              plus leak-detector attribution of a planted leak; persists
+              the record under benchmarks/results/
   (no flag)   self-orchestrating parent: bench.run_aux_ladder resilience
               ladder, persists the rung record under benchmarks/results/
 
@@ -300,6 +304,117 @@ def trace():
     print(json.dumps(rec))
 
 
+def chaos():
+    """Chaos-visibility acceptance run (ISSUE 11): the two-node chain
+    cluster with the dashboard up. Plants an intentionally leaked object,
+    runs head tasks, then SIGKILLs the worker node mid-flight and asserts:
+
+    - /api/cluster marks the node dead within one heartbeat interval
+      (TCP RST from the killed process breaks the head's read loop, so
+      detection is near-instant — the heartbeat interval is the bound)
+    - /api/alerts carries the node_dead event for that node id
+    - the leak detector flags the planted object with its owning task id
+      and trace id, surfaced both in /api/cluster leaks and as an
+      object_leak alert
+
+    Persists the record under benchmarks/results/ (committed artifact).
+    """
+    import urllib.request
+
+    # sub-second leak thresholds so the planted leak flags within the run;
+    # set before the cluster starts so the head controller reads them
+    os.environ["RAY_TPU_LEAK_AGE_S"] = "1.0"
+    os.environ["RAY_TPU_LEAK_SCAN_S"] = "0.5"
+    from ray_tpu._private.cluster import HEARTBEAT_S
+    cl = _Cluster()
+    try:
+        ray = cl.ray
+        from ray_tpu.dashboard import start_dashboard
+        _actor, port = start_dashboard(port=0)
+        base = f"http://127.0.0.1:{port}"
+
+        def get_json(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        @ray.remote(resources={"head_node": 0.01})
+        def make_block():
+            return b"x" * (1 << 20)
+
+        @ray.remote(resources={"head_node": 0.01})
+        def spin(i):
+            time.sleep(0.05)
+            return i
+
+        # the planted leak: the driver holds this ref for the whole run, so
+        # refcount stays >0 long past RAY_TPU_LEAK_AGE_S → "unreleased"
+        leak_ref = make_block.remote()
+        ray.get(leak_ref, timeout=60)
+
+        node_id = next(n["node_id"] for n in get_json("/api/cluster")["nodes"]
+                       if not n["is_head"])
+
+        # head-pinned tasks keep the scheduler busy through the kill (node
+        # tasks would hang the run on lineage needing dead-node resources)
+        inflight = [spin.remote(i) for i in range(40)]
+
+        os.killpg(cl.node.pid, signal.SIGKILL)
+        t_kill = time.perf_counter()
+        dead_row = None
+        while time.perf_counter() - t_kill < 5 * HEARTBEAT_S:
+            rows = get_json("/api/cluster")["nodes"]
+            dead_row = next((n for n in rows
+                             if n["node_id"] == node_id and not n["alive"]),
+                            None)
+            if dead_row is not None:
+                break
+            time.sleep(0.05)
+        detect_s = time.perf_counter() - t_kill
+        assert dead_row is not None, "killed node never marked dead"
+        assert detect_s <= HEARTBEAT_S, (
+            f"node-death visible only after {detect_s:.2f}s "
+            f"(> heartbeat {HEARTBEAT_S}s)")
+        alerts = get_json("/api/alerts")
+        node_alerts = [a for a in alerts
+                       if a["kind"] == "node_dead" and a["key"] == node_id]
+        assert node_alerts, f"no node_dead alert for {node_id}: {alerts}"
+
+        assert ray.get(inflight, timeout=60) == list(range(40))
+
+        # leak visibility: the scan runs on the reaper tick every
+        # RAY_TPU_LEAK_SCAN_S once the object is past RAY_TPU_LEAK_AGE_S
+        leak = None
+        deadline = time.time() + 10
+        while time.time() < deadline and leak is None:
+            leaks = get_json("/api/cluster")["leaks"]
+            leak = next((l for l in leaks
+                         if l["object_id"] == leak_ref.id), None)
+            if leak is None:
+                time.sleep(0.2)
+        assert leak is not None, "planted leak never flagged"
+        assert leak["reason"] == "unreleased", leak
+        assert leak["owner_task"], leak
+        assert leak["trace_id"], leak
+        leak_alerts = [a for a in get_json("/api/alerts")
+                       if a["kind"] == "object_leak"
+                       and a["key"] == leak_ref.id]
+        assert leak_alerts, "no object_leak alert for the planted leak"
+
+        rec = {"bench": "chaos_health", "heartbeat_s": HEARTBEAT_S,
+               "node_id": node_id, "death_detect_s": round(detect_s, 3),
+               "dead_row": dead_row,
+               "node_dead_alert": node_alerts[0],
+               "leak": leak, "leak_alert": leak_alerts[0],
+               "alerts_total": len(alerts)}
+        from bench import _write_result_artifact
+        rec["artifact"] = _write_result_artifact("chaos_health", rec)
+        print(json.dumps(rec))
+    finally:
+        cl.close()
+        os.environ.pop("RAY_TPU_LEAK_AGE_S", None)
+        os.environ.pop("RAY_TPU_LEAK_SCAN_S", None)
+
+
 def smoke():
     """Fast tier-1 hook: chain integrity both modes, dispatch-time hit rate
     >= 0.9 with prefetch on, and the overlap direction — prefetch must not
@@ -319,6 +434,8 @@ if __name__ == "__main__":
         smoke()
     elif "--trace" in sys.argv[1:]:
         trace()
+    elif "--chaos" in sys.argv[1:]:
+        chaos()
     else:
         # parent mode: resilience ladder (persists the result artifact)
         from bench import run_aux_ladder

@@ -151,6 +151,32 @@ def trace_overhead(n: int, reps: int = 2):
             "overhead_ratio": round(on / max(off, 1e-9), 3)}
 
 
+def health_overhead(n: int, reps: int = 2):
+    """Submit-latency cost of the health signal plane (ISSUE 11): pipelined
+    mode with RAY_TPU_HEALTH forced OFF vs ON (the default), interleaved
+    reps, best-of-reps p50 each — same discipline as trace_overhead. The
+    monitor reads the env per tick, but flipping before init also covers
+    the heartbeat payload on spawned agents."""
+    prev = os.environ.get("RAY_TPU_HEALTH")
+    p50 = {False: [], True: []}
+    try:
+        for _ in range(reps):
+            for on in (False, True):
+                os.environ["RAY_TPU_HEALTH"] = "1" if on else "0"
+                p50[on].append(
+                    run_mode(sync=False, n=n, fanout_m=4)["submit_p50_us"])
+    finally:
+        if prev is None:
+            os.environ.pop("RAY_TPU_HEALTH", None)
+        else:
+            os.environ["RAY_TPU_HEALTH"] = prev
+    off, on = min(p50[False]), min(p50[True])
+    return {"n": n, "reps": reps,
+            "submit_p50_off_us": off, "submit_p50_on_us": on,
+            "p50_off_all_us": p50[False], "p50_on_all_us": p50[True],
+            "overhead_ratio": round(on / max(off, 1e-9), 3)}
+
+
 def measure():
     from bench import _INIT_SENTINEL, observability_snapshot  # repo root on sys.path
     # no jax import here — the control plane can't wedge on a backend, so
@@ -172,6 +198,7 @@ def measure():
         out["pipelined"]["e2e_tps"] / max(out["blocking"]["e2e_tps"],
                                           1e-9), 2)
     out["tracing_overhead"] = trace_overhead(N, reps=2)
+    out["health_overhead"] = health_overhead(N, reps=2)
     out["observability"] = observability_snapshot()
     print(json.dumps(out))
 
@@ -197,6 +224,15 @@ def smoke():
     assert on_ <= max(off * 1.05, off + 2.0), (
         f"tracing overhead too high: p50 {off} -> {on_} us ({ov})")
     rec["tracing_overhead"] = ov
+    # health-gauge invariant (ISSUE 11): the signal plane must cost < 2%
+    # of submit p50 — the gauges live on the 1s reaper tick and the
+    # heartbeat, not on the submit path, so this guards against anything
+    # leaking into the hot path. Same 2 µs quantization grace as above.
+    hv = health_overhead(n=max(n * 4, 128), reps=2)
+    off, on_ = hv["submit_p50_off_us"], hv["submit_p50_on_us"]
+    assert on_ <= max(off * 1.02, off + 2.0), (
+        f"health-gauge overhead too high: p50 {off} -> {on_} us ({hv})")
+    rec["health_overhead"] = hv
     print(json.dumps({"bench": "core_control_plane_smoke", **rec}))
 
 

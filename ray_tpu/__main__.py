@@ -10,25 +10,87 @@ import json
 import sys
 
 
+def _fmt_bytes(n) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if n < 1024 or unit == "TiB":
+            return f"{n:.0f}{unit}" if unit == "B" else f"{n:.1f}{unit}"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def _render_status(health: dict, alerts: list) -> str:
+    """One top-style frame from /api/cluster + /api/alerts payloads. Pure
+    function of its inputs so tests render without a live cluster."""
+    lines = []
+    res = health.get("resources", {})
+    total, avail = res.get("total", {}), res.get("available", {})
+    lines.append("== Cluster ==")
+    for k in sorted(total):
+        lines.append(f"  {k:<14} {avail.get(k, 0):g} / {total[k]:g} free")
+    q = health.get("queue", {})
+    lines.append(f"  queue: ready={q.get('ready', 0)} "
+                 f"pending_deps={q.get('pending_deps', 0)}")
+    lines.append("== Nodes ==")
+    hdr = (f"  {'node':<14} {'alive':<6} {'hb_age':>7} {'queue':>6} "
+           f"{'busy':>5} {'idle':>5} {'store':>18} {'objs':>6}")
+    lines.append(hdr)
+    for n in health.get("nodes", []):
+        nid = str(n.get("node_id", "?"))[:14]
+        alive = "yes" if n.get("alive") else "DEAD"
+        store = (f"{_fmt_bytes(n.get('store_used'))}/"
+                 f"{_fmt_bytes(n.get('store_capacity'))}")
+        lines.append(
+            f"  {nid:<14} {alive:<6} {n.get('heartbeat_age_s', 0.0):>6.1f}s "
+            f"{n.get('queue_depth', 0):>6} {n.get('workers_busy', 0):>5} "
+            f"{n.get('workers_idle', 0):>5} {store:>18} "
+            f"{n.get('store_objects', 0):>6}")
+    leaks = health.get("leaks") or []
+    if leaks:
+        lines.append(f"== Leaks ({len(leaks)}) ==")
+        for leak in leaks[:10]:
+            lines.append(
+                f"  {leak['object_id']}  {leak['reason']}  "
+                f"age={leak['ledger']['age_s']:.0f}s  "
+                f"owner={leak.get('owner_task') or '-'}")
+    a = health.get("alerts", {})
+    lines.append(f"== Alerts (active={a.get('active', 0)}, "
+                 f"total={a.get('count', 0)}) ==")
+    for ev in (alerts or [])[-8:]:
+        lines.append(f"  [{ev.get('severity', '?'):<8}] {ev.get('kind')}: "
+                     f"{ev.get('message')}")
+    return "\n".join(lines)
+
+
 def _cmd_status(args):
+    import time
+
     import ray_tpu
     from ray_tpu.util import state as state_api
 
-    ray_tpu.init(ignore_reinit_error=True)
-    nodes = state_api.list_nodes()
-    print("== Cluster ==")
-    for n in nodes:
-        print(f"node {n['node_id']}  alive={n['alive']}")
-        print(f"  resources: {json.dumps(n['resources'])}")
-        print(f"  available: {json.dumps(n['available'])}")
-        used, cap = n["object_store_used"], n["object_store_capacity"]
-        print(f"  object store: {used}/{cap} bytes")
-    actors = state_api.list_actors()
-    print(f"== Actors ({len(actors)}) ==")
-    for a in actors:
-        print(f"  {a['actor_id']}  {a['state']:<12} name={a['name'] or '-'}")
-    print("== Tasks ==")
-    print(f"  {json.dumps(state_api.summarize_tasks())}")
+    _connect(getattr(args, "address", None))
+    watch = (not getattr(args, "once", False)) and sys.stdout.isatty()
+    try:
+        while True:
+            health = state_api.cluster_health()
+            alerts = state_api.list_alerts()
+            frame = _render_status(health, alerts)
+            if watch:
+                sys.stdout.write("\x1b[2J\x1b[H")  # clear + home (top-style)
+            print(frame)
+            actors = state_api.list_actors()
+            print(f"== Actors ({len(actors)}) ==")
+            for a in actors:
+                print(f"  {a['actor_id']}  {a['state']:<12} "
+                      f"name={a['name'] or '-'}")
+            print("== Tasks ==")
+            print(f"  {json.dumps(state_api.summarize_tasks())}")
+            if not watch:
+                break
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        pass
     ray_tpu.shutdown()
 
 
@@ -130,7 +192,14 @@ def _cmd_dashboard(args):
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu")
     sub = parser.add_subparsers(dest="cmd", required=True)
-    sub.add_parser("status", help="cluster resources / actors / tasks")
+    st = sub.add_parser("status",
+                        help="live cluster health (top-style when a TTY)")
+    st.add_argument("--address", default=None,
+                    help="controller socket path (default: RAY_TPU_ADDRESS)")
+    st.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period in watch mode (seconds)")
+    st.add_argument("--once", action="store_true",
+                    help="print one frame and exit (default off a TTY)")
     sub.add_parser("topology", help="TPU slice topology")
     tl = sub.add_parser("timeline", help="export chrome trace")
     tl.add_argument("--output", default="timeline.json")

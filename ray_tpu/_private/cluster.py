@@ -93,6 +93,13 @@ class NodeConn:
     ship_seq: int = 0                # per-node fwd_task sequence (see "stats")
     direct_pull_bytes: int = 0       # node-reported data-plane counters
     direct_serve_bytes: int = 0
+    # health signal plane: latest node-side health_snapshot() dict riding
+    # the heartbeat, plus observed heartbeat cadence/latency (latency from
+    # the node-stamped ts inside the payload; clocks on one host, so skew
+    # is bounded by NTP on multi-host)
+    health: Dict[str, object] = field(default_factory=dict)
+    hb_interval_s: float = 0.0
+    hb_latency_s: float = 0.0
 
 
 class ClusterServer:
@@ -150,6 +157,11 @@ class ClusterServer:
                     print(f"[cluster] node {node.node_id} heartbeat-silent "
                           f"{now - node.last_seen:.1f}s; declaring dead",
                           file=sys.stderr)
+                    try:
+                        self.c.health.note_heartbeat_missed(
+                            node.node_id, now - node.last_seen)
+                    except Exception:  # noqa: BLE001
+                        pass
                     node.alive = False
                     try:
                         node.writer.close()
@@ -202,6 +214,10 @@ class ClusterServer:
                         host=p.get("host", ""), pid=p.get("pid", 0),
                         data_addr=p.get("data_addr", ""))
         self.nodes[node.node_id] = node
+        try:
+            self.c.health.note_node_alive(node.node_id)
+        except Exception:  # noqa: BLE001
+            pass
         protocol.awrite_msg(writer, "register_ok", head_node_id=self.c.node_id)
         self.c._schedule()
         try:
@@ -244,7 +260,15 @@ class ClusterServer:
             node.available = base
             node.direct_pull_bytes = p.get("direct_pull_bytes", 0)
             node.direct_serve_bytes = p.get("direct_serve_bytes", 0)
-            node.last_seen = time.time()
+            now = time.time()
+            node.hb_interval_s = now - node.last_seen
+            h = p.get("health")
+            if h:
+                node.health = dict(h)
+                hts = node.health.get("ts")
+                if hts:
+                    node.hb_latency_s = max(now - hts, 0.0)
+            node.last_seen = now
             # traced spans shipped from the node (fire-and-forget batches)
             # merge into the head's timeline; pid was stamped node-side so
             # Perfetto groups them per process
@@ -827,6 +851,10 @@ class ClusterServer:
         print(f"[cluster] node {node.node_id} ({node.host}) disconnected; "
               f"failing over {len(node.inflight)} tasks, "
               f"{len(node.actors)} actors", file=sys.stderr)
+        try:
+            c.health.note_node_dead(node.node_id, node.host)
+        except Exception:  # noqa: BLE001
+            pass
         for tid, rec in list(node.inflight.items()):
             spec = rec.spec
             self._release_mirror(node, spec)
@@ -869,13 +897,18 @@ class ClusterServer:
 
     # --------------------------------------------------------------- surface
     def node_rows(self) -> List[dict]:
+        now = time.time()
         return [{"node_id": n.node_id, "alive": n.alive, "host": n.host,
                  "resources": dict(n.resources),
                  "available": dict(n.available),
                  "inflight": len(n.inflight), "actors": len(n.actors),
                  "data_addr": n.data_addr,
                  "direct_pull_bytes": n.direct_pull_bytes,
-                 "direct_serve_bytes": n.direct_serve_bytes}
+                 "direct_serve_bytes": n.direct_serve_bytes,
+                 "heartbeat_age_s": max(now - n.last_seen, 0.0),
+                 "hb_interval_s": n.hb_interval_s,
+                 "hb_latency_s": n.hb_latency_s,
+                 "health": dict(n.health)}
                 for n in self.nodes.values()]
 
     def totals(self) -> Dict[str, float]:

@@ -460,6 +460,10 @@ class Controller:
         # src/ray/gcs/gcs_server/gcs_node_manager.cc). None = single host.
         self._cluster_port = cluster_port
         self.cluster = None
+        # health signal plane: gauges + alert rules + leak detector,
+        # evaluated from the reaper tick (see _private/health.py)
+        from .health import HealthMonitor
+        self.health = HealthMonitor(self)
         self._pulls: Dict[str, asyncio.Task] = {}  # in-flight remote pulls
         # eager dependency pulls (single-flight per oid, byte-capped); built
         # in start() once the event loop exists
@@ -567,6 +571,10 @@ class Controller:
                 if w.proc.poll() is not None:
                     del self.spawning[wid]
                     self._on_worker_dead(w, f"worker process exited code={w.proc.returncode} before registering")
+            try:
+                self.health.tick()
+            except Exception:  # noqa: BLE001 - health must not kill the reaper
+                pass
             self._schedule()
 
     # ------------------------------------------------------- worker connection
@@ -924,6 +932,8 @@ class Controller:
                 meta = self.objects.get(v)
                 if meta is not None:
                     meta.pinned += 1
+                    if meta.ts_pinned == 0.0:
+                        meta.ts_pinned = time.time()
                     rec.pinned.append(v)
                 if meta is None or meta.location == "pending":
                     rec.deps_remaining.add(v)
@@ -954,6 +964,8 @@ class Controller:
             meta = self.objects.get(v)
             if meta is not None:
                 meta.pinned += 1
+                if meta.ts_pinned == 0.0:
+                    meta.ts_pinned = time.time()
                 rec.pinned.append(v)
         self._validate_feasible(rec)
         if rec.state == FAILED:
@@ -1437,6 +1449,75 @@ class Controller:
             out["provider_nodes"] = list(self._provider_nodes)
         return out
 
+    # ------------------------------------------------- health signal plane
+    def health_snapshot(self) -> dict:
+        """This process's node-local health gauges. On the head this is the
+        head row of cluster_health(); on node agents the same dict rides
+        every heartbeat (node_agent._heartbeat) — no extra round trips."""
+        busy = sum(1 for w in self.workers.values() if w.state == "busy")
+        idle = sum(1 for w in self.workers.values() if w.state == "idle")
+        pool = busy + idle
+        from . import object_store as _os_mod
+        return {
+            "ts": time.time(),
+            "queue_depth": len(self.ready_queue),
+            # tasks parked on unresolved deps (deduped: one task can wait on
+            # several objects)
+            "dispatch_backlog": len({tid for s in self.dep_waiters.values()
+                                     for tid in s}),
+            "workers_total": len(self.workers),
+            "workers_busy": busy,
+            "workers_idle": idle,
+            "worker_occupancy": (busy / pool) if pool else 0.0,
+            "store_used": self.store_used,
+            "store_capacity": self.store_capacity,
+            "store_free": max(self.store_capacity - self.store_used, 0),
+            "store_pinned_bytes": sum(m.size for m in self.objects.values()
+                                      if m.pinned > 0 and m.location == "shm"),
+            "store_objects": len(self.objects),
+            "store_alloc_failures": _os_mod.alloc_failures(),
+        }
+
+    def cluster_health(self) -> dict:
+        """Aggregate health view served at GET /api/cluster and by
+        `python -m ray_tpu status`: one row per node (head first), dead-node
+        tombstones included so a killed node stays visible, plus resource
+        totals, the alert tail, and the current leak list."""
+        now = time.time()
+        head = dict(self.health_snapshot())
+        head.update(node_id=self.node_id, is_head=True, alive=True,
+                    host="head", heartbeat_age_s=0.0)
+        rows = [head]
+        live = {self.node_id}
+        if self.cluster is not None:
+            for n in list(self.cluster.nodes.values()):
+                live.add(n.node_id)
+                row = dict(n.health or {})
+                row.update(node_id=n.node_id, is_head=False, alive=n.alive,
+                           host=n.host,
+                           heartbeat_age_s=max(now - n.last_seen, 0.0),
+                           hb_interval_s=n.hb_interval_s,
+                           hb_latency_s=n.hb_latency_s,
+                           inflight=len(n.inflight))
+                rows.append(row)
+        for node_id, tomb in self.health.dead_nodes.items():
+            if node_id not in live:
+                rows.append(dict(tomb))
+        alerts = self.health.alerts
+        return {
+            "ts": now,
+            "nodes": rows,
+            "resources": {"total": self.res_total(),
+                          "available": self.res_available()},
+            "queue": {"ready": len(self.ready_queue),
+                      "pending_deps": len({tid for s in self.dep_waiters.values()
+                                           for tid in s})},
+            "alerts": {"count": len(alerts.events()),
+                       "active": alerts.active_count(),
+                       "recent": alerts.events()[-5:]},
+            "leaks": list(self.health.leaks),
+        }
+
     # env vars that bind a process to the accelerator runtime; stripped for
     # CPU-only workers (see WorkerConn.tpu_capable). Single source of truth:
     # ray_tpu/util/tpu.py (shared with bench.py / __graft_entry__).
@@ -1695,8 +1776,10 @@ class Controller:
             meta = self.objects.get(oid)
             if meta:
                 meta.pinned = max(meta.pinned - 1, 0)
-                if meta.refcount <= 0 and meta.pinned == 0:
-                    self._evict(oid)
+                if meta.pinned == 0:
+                    meta.ts_pinned = 0.0
+                    if meta.refcount <= 0:
+                        self._evict(oid)
         rec.pinned.clear()
         for aid in rec.pinned_actors:
             self.actor_decref(aid)
@@ -1777,6 +1860,8 @@ class Controller:
             self.incref(meta.contained)
         meta.meta_len = meta_len
         meta.size = size
+        if meta.ts_sealed == 0.0:
+            meta.ts_sealed = time.time()
         if inline is not None:
             meta.location = "inline"
             meta.inline_value = inline
@@ -1815,6 +1900,8 @@ class Controller:
             self.incref(meta.contained)
         meta.size = size
         meta.meta_len = meta_len
+        if meta.ts_sealed == 0.0:
+            meta.ts_sealed = time.time()
         meta.location = f"remote:{node_id}"
         meta.holders = []  # fresh authoritative copy: old holders are stale
         self.object_events[oid].set()
@@ -1843,6 +1930,8 @@ class Controller:
         if p.get("contained") and not meta.contained:
             meta.contained = list(p["contained"])
             self.incref(meta.contained)
+        if meta.ts_sealed == 0.0:
+            meta.ts_sealed = time.time()
         if p["enc"] == "inline":
             meta.location = "inline"
             meta.inline_value = p["data"]
@@ -1898,11 +1987,15 @@ class Controller:
         meta = self.objects.get(oid)
         if meta is not None:
             meta.pinned += 1
+            if meta.ts_pinned == 0.0:
+                meta.ts_pinned = time.time()
 
     def _unpin_for_pull(self, oid: str):
         meta = self.objects.get(oid)
         if meta is not None and meta.pinned > 0:
             meta.pinned -= 1
+            if meta.pinned == 0:
+                meta.ts_pinned = 0.0
 
     def _prefetch_worthwhile(self, spec: TaskSpec, meta: ObjectMeta) -> bool:
         """Would an eager HEAD-side pull of this remote arg help this task?
@@ -2246,8 +2339,11 @@ class Controller:
             if meta is None:
                 continue
             meta.refcount -= 1
-            if meta.refcount <= 0 and meta.pinned == 0:
-                self._evict(oid)
+            if meta.refcount <= 0:
+                if meta.ts_released == 0.0:
+                    meta.ts_released = time.time()
+                if meta.pinned == 0:
+                    self._evict(oid)
 
     def incref(self, oids: List[str]):
         for oid in oids:
@@ -2420,6 +2516,8 @@ class Controller:
             arg_meta = self.objects.get(v)
             if arg_meta is not None:
                 arg_meta.pinned += 1
+                if arg_meta.ts_pinned == 0.0:
+                    arg_meta.ts_pinned = time.time()
                 fresh.pinned.append(v)
             if arg_meta is None or arg_meta.location == "pending":
                 fresh.deps_remaining.add(v)
@@ -3006,8 +3104,12 @@ class Controller:
                     for t in sorted(self.tasks.values(),
                                     key=lambda t: t.ts_submit, reverse=True)]
         if kind == "objects":
+            from .health import ledger_ages
+            now = time.time()
             return [{"object_id": o.object_id, "size": o.size, "location": o.location,
-                     "refcount": o.refcount, "pinned": o.pinned}
+                     "refcount": o.refcount, "pinned": o.pinned,
+                     "creating_task": o.creating_task,
+                     **ledger_ages(o, now)}
                     for o in self.objects.values()]
         if kind == "workers":
             return [{"worker_id": w.worker_id, "state": w.state, "pid": w.pid,
@@ -3033,7 +3135,16 @@ class Controller:
         if kind == "metrics":
             # this process's util.metrics registry — the controller process
             # holds the scheduler/prefetch/transfer series, so remote
-            # surfaces (dashboard actor) scrape through here
+            # surfaces (dashboard actor) scrape through here; gauges are
+            # refreshed at scrape time so a scrape never races the 1 Hz tick
             from ..util import metrics
+            try:
+                self.health.publish_gauges()
+            except Exception:  # noqa: BLE001 - a scrape never fails
+                pass
             return metrics.collect()
+        if kind == "cluster_health":
+            return self.cluster_health()
+        if kind == "alerts":
+            return self.health.alerts.events()
         raise ValueError(f"unknown state kind {kind}")

@@ -1,0 +1,320 @@
+"""Cluster health signal plane: store/scheduler gauges, an object-lifetime
+leak detector, and a threshold-rule alert event log.
+
+Reference: Ray's GCS-backed monitoring model (the paper's §4 control plane
+treats store fullness, queue depth, and heartbeat liveness as the
+scheduler's sensory input) and the reporter/dashboard agents
+(python/ray/dashboard/modules/reporter) that gauge each node. TPU-native
+cut: there is no extra agent process and no new round trip — every gauge
+is computed inside the controller that already owns the data, shipped on
+the existing 1s heartbeat "stats" frame (the PR 9 span-batch trick), and
+evaluated by one HealthMonitor tick inside the head's reaper loop.
+
+Everything here is clock-injectable so tests drive the ledger and the
+leak detector deterministically (fake clock, no sleeps).
+
+Env knobs:
+  RAY_TPU_HEALTH                 "0" disables the monitor tick (default on)
+  RAY_TPU_LEAK_AGE_S             leak threshold age in seconds (default 600)
+  RAY_TPU_LEAK_SCAN_S            seconds between leak scans (default 5)
+  RAY_TPU_ALERT_STORE_PCT        store-pressure threshold percent (default 90)
+  RAY_TPU_ALERT_QUEUE_INTERVALS  consecutive growth intervals (default 5)
+  RAY_TPU_ALERT_LOG_LEN          alert event ring capacity (default 256)
+"""
+
+import collections
+import os
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+
+def enabled() -> bool:
+    return os.environ.get("RAY_TPU_HEALTH", "1") not in ("0", "false")
+
+
+def leak_age_s() -> float:
+    return float(os.environ.get("RAY_TPU_LEAK_AGE_S", "600"))
+
+
+def leak_scan_interval_s() -> float:
+    return float(os.environ.get("RAY_TPU_LEAK_SCAN_S", "5"))
+
+
+def alert_store_pct() -> float:
+    return float(os.environ.get("RAY_TPU_ALERT_STORE_PCT", "90"))
+
+
+def alert_queue_intervals() -> int:
+    return max(2, int(os.environ.get("RAY_TPU_ALERT_QUEUE_INTERVALS", "5")))
+
+
+def alert_log_len() -> int:
+    return int(os.environ.get("RAY_TPU_ALERT_LOG_LEN", "256"))
+
+
+# ------------------------------------------------------------------- ledger
+def ledger_ages(meta, now: float) -> Dict[str, float]:
+    """created→sealed→pinned→released ages for one ObjectMeta, from the
+    timestamps the controller stamps at each lifecycle transition. Pure
+    function of (meta, now) so tests assert exact values with a fake
+    clock."""
+    out = {"age_s": max(now - meta.ts_created, 0.0)}
+    if meta.ts_sealed:
+        out["seal_latency_s"] = max(meta.ts_sealed - meta.ts_created, 0.0)
+        out["sealed_age_s"] = max(now - meta.ts_sealed, 0.0)
+    if meta.pinned > 0 and meta.ts_pinned:
+        out["pinned_age_s"] = max(now - meta.ts_pinned, 0.0)
+    if meta.ts_released:
+        out["released_age_s"] = max(now - meta.ts_released, 0.0)
+    return out
+
+
+class LeakDetector:
+    """Flags objects stuck in the table past a configurable age: still
+    PINNED (a lost unpin keeps them unevictable forever) or unreleased
+    (live refcount) long after sealing. Each flag carries the owning
+    task id and its derived trace id so the leak is attributable to the
+    submit that produced it."""
+
+    def __init__(self, age_s: Optional[float] = None,
+                 clock: Callable[[], float] = time.time):
+        self.age_s = age_s  # None = read RAY_TPU_LEAK_AGE_S per scan
+        self.clock = clock
+
+    def scan(self, objects: Dict[str, object],
+             now: Optional[float] = None) -> List[dict]:
+        now = self.clock() if now is None else now
+        age_s = self.age_s if self.age_s is not None else leak_age_s()
+        from ..util import tracing
+        out = []
+        for oid, meta in list(objects.items()):
+            if meta.location == "error":
+                continue
+            reason = None
+            if meta.pinned > 0:
+                pinned_since = meta.ts_pinned or meta.ts_created
+                if now - pinned_since > age_s:
+                    reason = "pinned"
+            if (reason is None and meta.refcount > 0 and meta.ts_sealed
+                    and now - meta.ts_created > age_s):
+                reason = "unreleased"
+            if reason is None:
+                continue
+            owner = meta.creating_task
+            out.append({
+                "object_id": oid, "size": meta.size,
+                "location": meta.location, "refcount": meta.refcount,
+                "pinned": meta.pinned, "reason": reason,
+                "owner_task": owner,
+                "trace_id": tracing.trace_id_for(owner) if owner else None,
+                "ledger": ledger_ages(meta, now)})
+        return out
+
+
+# -------------------------------------------------------------- alert log
+class AlertLog:
+    """Bounded, deduplicating alert event log. `fire` records ONE event
+    per (kind, key) while the condition persists; `resolve` re-arms the
+    pair so a recurrence is a fresh event (threshold alerts don't spam
+    the ring every evaluation tick)."""
+
+    def __init__(self, maxlen: Optional[int] = None,
+                 clock: Callable[[], float] = time.time):
+        self._events: collections.deque = collections.deque(
+            maxlen=maxlen or alert_log_len())
+        self._active: Dict[Tuple[str, str], float] = {}
+        self.clock = clock
+        self._seq = 0
+
+    def fire(self, kind: str, key: str, message: str,
+             severity: str = "warning", **data) -> Optional[dict]:
+        if (kind, key) in self._active:
+            return None
+        self._seq += 1
+        ev = {"id": self._seq, "ts": self.clock(), "kind": kind, "key": key,
+              "severity": severity, "message": message, "data": data}
+        self._active[(kind, key)] = ev["ts"]
+        self._events.append(ev)
+        return ev
+
+    def resolve(self, kind: str, key: str) -> None:
+        self._active.pop((kind, key), None)
+
+    def active_keys(self) -> List[Tuple[str, str]]:
+        return list(self._active)
+
+    def active_count(self) -> int:
+        return len(self._active)
+
+    def events(self, limit: Optional[int] = None) -> List[dict]:
+        """Chronological event list (oldest first)."""
+        evs = list(self._events)
+        return evs if limit is None else evs[-limit:]
+
+
+# --------------------------------------------------------- health monitor
+class HealthMonitor:
+    """Head-side evaluator, ticked from the controller's 1s reaper loop.
+
+    Each tick republishes every node's heartbeat-shipped health dict (plus
+    the head's own) as tagged registry gauges, evaluates the threshold
+    rules (store pressure, monotone queue growth, leak age), and appends
+    alert events. Node-death alerts are pushed directly from the cluster
+    server's failover path so they land within the heartbeat interval
+    rather than the next tick."""
+
+    def __init__(self, controller, clock: Callable[[], float] = time.time):
+        self.c = controller
+        self.clock = clock
+        self.alerts = AlertLog(clock=clock)
+        self.detector = LeakDetector(clock=clock)
+        self.leaks: List[dict] = []
+        # tombstones for /api/cluster: dead nodes are popped from the live
+        # node table on disconnect, but "marks the node dead" requires the
+        # row to survive
+        self.dead_nodes: Dict[str, dict] = {}
+        self._queue_hist: Dict[str, collections.deque] = {}
+        self._last_scan = 0.0
+
+    # -- node lifecycle hooks (called by ClusterServer) ---------------------
+    def note_node_alive(self, node_id: str) -> None:
+        self.dead_nodes.pop(node_id, None)
+        self.alerts.resolve("node_dead", node_id)
+        self.alerts.resolve("node_heartbeat_missed", node_id)
+
+    def note_heartbeat_missed(self, node_id: str, silence_s: float) -> None:
+        self._fire("node_heartbeat_missed", node_id,
+                   f"node {node_id} heartbeat-silent {silence_s:.1f}s",
+                   severity="critical", silence_s=round(silence_s, 1))
+
+    def note_node_dead(self, node_id: str, host: str = "",
+                       reason: str = "disconnected") -> None:
+        self.dead_nodes[node_id] = {
+            "node_id": node_id, "is_head": False, "alive": False,
+            "host": host, "dead_since": self.clock(), "reason": reason}
+        self._fire("node_dead", node_id, f"node {node_id} {reason}",
+                   severity="critical", host=host, reason=reason)
+
+    # -- internals ----------------------------------------------------------
+    def _fire(self, kind, key, message, severity="warning", **data):
+        ev = self.alerts.fire(kind, key, message, severity=severity, **data)
+        if ev is not None:
+            try:
+                from ..util import metrics
+                metrics.get_or_create(
+                    metrics.Counter, "cluster_alerts_total",
+                    "alert events by rule kind", tag_keys=("kind",)
+                ).inc(tags={"kind": kind})
+            except Exception:  # noqa: BLE001 - alerts must not need metrics
+                pass
+        return ev
+
+    def _gauge(self, name, desc, value, node_id):
+        from ..util import metrics
+        metrics.get_or_create(metrics.Gauge, name, desc,
+                              tag_keys=("node",)).set(
+            value, tags={"node": node_id})
+
+    def _publish_gauges(self, node_id: str, h: dict, hb_age: float) -> None:
+        g = self._gauge
+        g("cluster_queue_depth", "deps-ready tasks awaiting dispatch",
+          h.get("queue_depth", 0), node_id)
+        g("cluster_dispatch_backlog", "submitted tasks still gated on deps",
+          h.get("dispatch_backlog", 0), node_id)
+        g("cluster_workers_busy", "pool workers executing a task",
+          h.get("workers_busy", 0), node_id)
+        g("cluster_workers_idle", "pool workers awaiting dispatch",
+          h.get("workers_idle", 0), node_id)
+        g("cluster_worker_occupancy", "busy / (busy + idle) pool fraction",
+          h.get("worker_occupancy", 0.0), node_id)
+        g("cluster_heartbeat_age_s", "seconds since the node's last stats frame",
+          hb_age, node_id)
+        g("cluster_store_used_bytes", "object store bytes in use",
+          h.get("store_used", 0), node_id)
+        g("cluster_store_free_bytes", "object store bytes free",
+          h.get("store_free", 0), node_id)
+        g("cluster_store_pinned_bytes", "bytes held by pinned shm objects",
+          h.get("store_pinned_bytes", 0), node_id)
+        g("cluster_store_objects", "object table entries",
+          h.get("store_objects", 0), node_id)
+        g("cluster_store_alloc_failures", "store allocation failures",
+          h.get("store_alloc_failures", 0), node_id)
+
+    def _rows(self, now: float):
+        c = self.c
+        rows = [(c.node_id, c.health_snapshot(), 0.0, True)]
+        if c.cluster is not None:
+            for n in list(c.cluster.nodes.values()):
+                rows.append((n.node_id, dict(n.health or {}),
+                             max(now - n.last_seen, 0.0), n.alive))
+        return rows
+
+    def publish_gauges(self) -> None:
+        """Refresh every cluster_* gauge family from current state without
+        evaluating alert rules — the scrape-time collection path, so a
+        GET /api/metrics issued before the first 1 Hz tick (or between
+        ticks) still sees current values. Rules stay on the tick cadence:
+        the queue-growth window must sample at a fixed interval."""
+        if not enabled():
+            return
+        for node_id, h, hb_age, _alive in self._rows(self.clock()):
+            self._publish_gauges(node_id, h, hb_age)
+
+    def tick(self) -> None:
+        """One evaluation pass; swallows nothing (callers wrap) but touches
+        only in-process state, so it is cheap and cannot block the loop."""
+        if not enabled():
+            return
+        now = self.clock()
+        for node_id, h, hb_age, alive in self._rows(now):
+            self._publish_gauges(node_id, h, hb_age)
+            if not alive or not h:
+                continue
+            cap = h.get("store_capacity") or 0
+            used = h.get("store_used") or 0
+            if cap and used >= cap * alert_store_pct() / 100.0:
+                self._fire("store_pressure", node_id,
+                           f"object store {100.0 * used / cap:.0f}% full "
+                           f"on {node_id}", used=used, capacity=cap)
+            else:
+                self.alerts.resolve("store_pressure", node_id)
+            self._queue_rule(node_id, h.get("queue_depth", 0))
+        if now - self._last_scan >= leak_scan_interval_s():
+            self._last_scan = now
+            self._leak_rule(now)
+
+    def _queue_rule(self, node_id: str, depth: int) -> None:
+        n_int = alert_queue_intervals()
+        dq = self._queue_hist.get(node_id)
+        if dq is None or dq.maxlen != n_int + 1:
+            dq = collections.deque(dq or (), maxlen=n_int + 1)
+            self._queue_hist[node_id] = dq
+        dq.append(depth)
+        hist = list(dq)
+        growing = (len(hist) == dq.maxlen
+                   and all(b > a for a, b in zip(hist, hist[1:])))
+        if growing:
+            self._fire("queue_growth", node_id,
+                       f"queue depth on {node_id} grew {n_int} consecutive "
+                       f"intervals (now {depth})",
+                       depth=depth, intervals=n_int)
+        else:
+            self.alerts.resolve("queue_growth", node_id)
+
+    def _leak_rule(self, now: float) -> None:
+        self.leaks = self.detector.scan(self.c.objects, now)
+        flagged = set()
+        for leak in self.leaks:
+            flagged.add(leak["object_id"])
+            self._fire(
+                "object_leak", leak["object_id"],
+                f"object {leak['object_id']} {leak['reason']} for "
+                f"{leak['ledger']['age_s']:.1f}s "
+                f"(owner task {leak['owner_task']})",
+                object_id=leak["object_id"], reason=leak["reason"],
+                owner_task=leak["owner_task"], trace_id=leak["trace_id"],
+                size=leak["size"], pinned=leak["pinned"],
+                refcount=leak["refcount"])
+        for kind, key in self.alerts.active_keys():
+            if kind == "object_leak" and key not in flagged:
+                self.alerts.resolve(kind, key)

@@ -648,11 +648,15 @@ class NodeAgent:
         meta = self.c.objects.get(oid)
         if meta is not None:
             meta.pinned += 1
+            if meta.ts_pinned == 0.0:
+                meta.ts_pinned = time.time()
 
     def _unpin_obj(self, oid: str):
         meta = self.c.objects.get(oid)
         if meta is not None and meta.pinned > 0:
             meta.pinned -= 1
+            if meta.pinned == 0:
+                meta.ts_pinned = 0.0
 
     # ------------------------------------------------------------ lifecycle
     async def run(self):
@@ -703,10 +707,17 @@ class NodeAgent:
                 pid = os.getpid()
                 for ev in spans:
                     ev["pid"] = pid
+                # node-local health gauges ride the same frame (no extra
+                # round trip); ts inside lets the head derive hb latency
+                try:
+                    health = self.c.health_snapshot()
+                except Exception:  # noqa: BLE001
+                    health = {}
                 protocol.awrite_msg(
                     self.writer, "stats",
                     available=dict(self.c.available),
                     total=dict(self.c.total),
+                    health=health,
                     # echo of the highest fwd_task seq processed: lets the
                     # head re-debit claims this snapshot can't reflect yet
                     fwd_seq=self.last_fwd_seq,

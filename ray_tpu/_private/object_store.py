@@ -58,6 +58,27 @@ def seg_name(object_id: str) -> str:
     return "rtpu-" + object_id[-16:]
 
 
+# Per-process allocation-failure tally (health plane): bumped when the slab
+# or a POSIX segment refuses an allocation — the store-is-full / shm-limit
+# signal the alert rules and bench records watch.
+_alloc_failures = 0
+
+
+def alloc_failures() -> int:
+    return _alloc_failures
+
+
+def _note_alloc_failure():
+    global _alloc_failures
+    _alloc_failures += 1
+    try:
+        from ray_tpu.util import metrics
+        metrics.get_or_create(metrics.Counter, "store_alloc_failures",
+                              "object store allocation failures").inc()
+    except Exception:  # noqa: BLE001 - accounting must not mask the error
+        pass
+
+
 class LocalObject:
     """A deserialized-on-demand handle pinning its shm segment."""
 
@@ -141,10 +162,37 @@ class StoreClient:
     # -- write path ---------------------------------------------------------
     # (no whole-object put here: serialization must flow through the clients'
     # _encode_to_store so contained ObjectRef ids are never dropped)
+    def _slab_alloc(self, object_id: str, size: int) -> int:
+        try:
+            return self._slab.alloc(object_id, size)
+        except Exception:
+            _note_alloc_failure()
+            raise
+
+    def _new_segment(self, object_id: str, size: int):
+        """Create the object's POSIX segment, replacing a stale one from a
+        crashed/retried attempt at the same oid (the object is only
+        registered on task_done). Allocation failures are tallied for the
+        health plane before propagating."""
+        try:
+            return shared_memory.SharedMemory(name=seg_name(object_id),
+                                              create=True, size=size)
+        except FileExistsError:
+            self.delete_segment(object_id)
+            try:
+                return shared_memory.SharedMemory(name=seg_name(object_id),
+                                                  create=True, size=size)
+            except Exception:
+                _note_alloc_failure()
+                raise
+        except Exception:
+            _note_alloc_failure()
+            raise
+
     def put_parts(self, object_id: str, meta: bytes, buffers) -> int:
         size = serialization.total_size(meta, buffers)
         if self._slab is not None:
-            off = self._slab.alloc(object_id, max(size, 1))
+            off = self._slab_alloc(object_id, max(size, 1))
             mv = self._slab.view(off, max(size, 1))
             mv[: len(meta)] = meta
             pos = len(meta)
@@ -152,15 +200,7 @@ class StoreClient:
                 mv[pos : pos + b.nbytes] = b
                 pos += b.nbytes
             return size
-        try:
-            shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True,
-                                             size=max(size, 1))
-        except FileExistsError:
-            # stale segment from a crashed/retried attempt at the same result
-            # oid — replace it (the object is only registered on task_done)
-            self.delete_segment(object_id)
-            shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True,
-                                             size=max(size, 1))
+        shm = self._new_segment(object_id, max(size, 1))
         _unregister(shm)
         mv = shm.buf
         mv[: len(meta)] = meta
@@ -177,27 +217,20 @@ class StoreClient:
         from the wire, Seal). Parallel transfer streams recv_into disjoint
         slices of the view, so there is no reassembly copy."""
         if self._slab is not None:
-            off = self._slab.alloc(object_id, max(size, 1))
+            off = self._slab_alloc(object_id, max(size, 1))
             return WritableBuffer(self, object_id,
                                   self._slab.view(off, max(size, 1)))
-        try:
-            shm = shared_memory.SharedMemory(name=seg_name(object_id),
-                                             create=True, size=max(size, 1))
-        except FileExistsError:
-            # stale segment from a crashed/retried transfer of the same oid
-            self.delete_segment(object_id)
-            shm = shared_memory.SharedMemory(name=seg_name(object_id),
-                                             create=True, size=max(size, 1))
+        shm = self._new_segment(object_id, max(size, 1))
         _unregister(shm)
         return WritableBuffer(self, object_id, shm.buf, shm=shm)
 
     def put_raw(self, object_id: str, blob: bytes) -> int:
         """Store pre-packed bytes (used when restoring spilled objects)."""
         if self._slab is not None:
-            off = self._slab.alloc(object_id, max(len(blob), 1))
+            off = self._slab_alloc(object_id, max(len(blob), 1))
             self._slab.view(off, len(blob))[:] = blob
             return len(blob)
-        shm = shared_memory.SharedMemory(name=seg_name(object_id), create=True, size=max(len(blob), 1))
+        shm = self._new_segment(object_id, max(len(blob), 1))
         _unregister(shm)
         shm.buf[: len(blob)] = blob
         shm.close()

@@ -5,6 +5,7 @@ descriptor, args (inline value or ObjectRef), resource demands, retry policy,
 actor info. Same shape here, as a plain pickleable dataclass.
 """
 
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -88,3 +89,12 @@ class ObjectMeta:
     # the local copy landed via an eager dependency pull (dispatch credits
     # the pull's wall time to prefetch_overlap_saved_ms on first hit)
     prefetched: bool = False
+    # lifetime ledger (health.ledger_ages / leak detector): created is
+    # stamped at table entry; sealed when bytes first land; pinned tracks
+    # the current pinned>0 stretch (cleared when the pin count returns to
+    # 0); released when the refcount first hits 0 — a released-but-pinned
+    # object lingering here is exactly the leak shape the detector flags
+    ts_created: float = field(default_factory=time.time)
+    ts_sealed: float = 0.0
+    ts_pinned: float = 0.0
+    ts_released: float = 0.0

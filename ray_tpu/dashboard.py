@@ -68,9 +68,10 @@ class DashboardActor:
                     resp = await self._route(req)
                 except ValueError as e:
                     resp = Response(json.dumps({"error": str(e)}).encode(), 404)
-                except Exception:  # noqa: BLE001 - handler error → 500
-                    resp = Response(traceback.format_exc().encode(), 500,
-                                    media_type="text/plain")
+                except Exception as e:  # noqa: BLE001 - handler error → 500
+                    resp = Response(json.dumps({
+                        "error": f"{type(e).__name__}: {e}",
+                        "traceback": traceback.format_exc()}).encode(), 500)
                 await write_http_response(writer, resp)
                 if req.headers.get("connection", "").lower() == "close":
                     break
@@ -105,6 +106,13 @@ class DashboardActor:
             return _coerce_response(client.state(path.rsplit("/", 1)[-1]))
         if path == "/api/autoscaler":
             return _coerce_response(client.autoscaler_status())
+        if path == "/api/cluster":
+            return _coerce_response(client.state("cluster_health"))
+        if path == "/api/alerts":
+            return _coerce_response(client.state("alerts"))
+        if path == "/api/_boom":
+            # test hook: exercises the JSON-500 error path end to end
+            raise RuntimeError("boom (dashboard 500 test hook)")
         if path in ("/api/metrics", "/metrics"):
             # Prometheus text exposition of every util.metrics
             # Counter/Gauge/Histogram: the controller process's registry
@@ -278,21 +286,42 @@ def _cluster_snapshots(client):
     ]
 
 
+def _esc_label(v) -> str:
+    """Label-value escaping per the text exposition format: backslash,
+    double quote, and newline must be escaped or the sample line is
+    unparseable."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _esc_help(v) -> str:
+    # HELP text: only backslash and newline are escaped (quotes are legal)
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _prometheus_text(snapshots) -> str:
     """Render util.metrics snapshots in Prometheus text exposition format
-    (ref: ray's metrics agent scrape endpoint)."""
+    (ref: ray's metrics agent scrape endpoint). Conformant: label values
+    escaped, # HELP/# TYPE emitted once per family even when the same name
+    shows up in several merged registries, counters suffixed `_total`."""
     def lbl(k, extra=()):
         items = tuple(k) + tuple(extra)
         if not items:
             return ""
-        return "{" + ",".join(f'{a}="{b}"' for a, b in items) + "}"
+        return ("{" + ",".join(f'{a}="{_esc_label(b)}"' for a, b in items)
+                + "}")
 
     lines = []
+    seen = set()
     for m in snapshots:
         name = m["name"].replace(".", "_").replace("-", "_")
-        if m.get("description"):
-            lines.append(f"# HELP {name} {m['description']}")
-        lines.append(f"# TYPE {name} {m['type']}")
+        if m["type"] == "counter" and not name.endswith("_total"):
+            name += "_total"
+        if name not in seen:
+            seen.add(name)
+            if m.get("description"):
+                lines.append(f"# HELP {name} {_esc_help(m['description'])}")
+            lines.append(f"# TYPE {name} {m['type']}")
         if m["type"] in ("counter", "gauge"):
             for k, v in m["values"].items():
                 lines.append(f"{name}{lbl(k)} {v}")

@@ -271,6 +271,32 @@ class LLMServer:
             _metrics.Histogram, "serve_decode_chunk_latency_ms",
             "wall latency of one fused decode chunk (ms)",
             boundaries=[1, 2, 5, 10, 20, 50, 100, 200, 500, 1000])
+        # serving SLO histograms (TTFT / TPOT / occupancy / KV utilization),
+        # tagged by engine flavor so paged and dense replicas in one process
+        # keep separate series; stats()["slo"] summarizes via
+        # metrics.histogram_summary
+        self._slo_tags = {"engine": ("paged" if self.page_mgr is not None
+                                     else "dense")}
+        self._m_ttft = _metrics.get_or_create(
+            _metrics.Histogram, "serve_ttft_s",
+            "time to first token: admit → first emitted token (s)",
+            boundaries=[0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10],
+            tag_keys=("engine",))
+        self._m_tpot = _metrics.get_or_create(
+            _metrics.Histogram, "serve_tpot_ms",
+            "per-token decode latency: host-sync wall time / tokens (ms)",
+            boundaries=[0.5, 1, 2, 5, 10, 20, 50, 100, 200],
+            tag_keys=("engine",))
+        self._m_occupancy = _metrics.get_or_create(
+            _metrics.Histogram, "serve_batch_occupancy",
+            "active slots / batch capacity, sampled per decode sync",
+            boundaries=[0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0],
+            tag_keys=("engine",))
+        self._m_kv_util = _metrics.get_or_create(
+            _metrics.Histogram, "serve_kv_page_util",
+            "KV pages in use / page pool size, sampled per decode sync",
+            boundaries=[0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0],
+            tag_keys=("engine",))
         self._free = list(range(B))
         self._req_counter = 0
         self._tick_task = None
@@ -520,7 +546,16 @@ class LLMServer:
         self._m_syncs.inc()
         if tokens:
             self._m_tokens.inc(tokens)
+            self._m_tpot.observe(dt_s / tokens * 1e3, tags=self._slo_tags)
         self._m_chunk_ms.observe(dt_s * 1e3)
+        cap = len(self._active) + len(self._free)
+        if cap:
+            self._m_occupancy.observe(len(self._active) / cap,
+                                      tags=self._slo_tags)
+        if self.page_mgr is not None and self.page_mgr.num_pages:
+            self._m_kv_util.observe(
+                self.page_mgr.pages_in_use / self.page_mgr.num_pages,
+                tags=self._slo_tags)
         from ray_tpu.util import tracing
         if tracing.enabled():
             # one span per device round trip — the decode timeline shows
@@ -581,6 +616,7 @@ class LLMServer:
                      top_k: Optional[int] = None,
                      logprobs: bool = False) -> _Slot:
         P = len(prompt_ids)
+        t_admit = time.monotonic()
         # feasibility (max_seq_len, page-pool capacity) raises in _reserve
         slot_idx, cached = await self._reserve(prompt_ids, P + max_tokens)
         slot = self._make_slot(P, max_tokens, eos_id, stream, temperature,
@@ -602,6 +638,10 @@ class LLMServer:
         await slot.first_token.wait()
         if slot.error is not None:
             raise RuntimeError("prefill failed") from slot.error
+        # TTFT = admission (queueing for a slot/pages included) → first
+        # token available; both generate and generate_stream come through
+        # here, so the histogram covers every request
+        self._m_ttft.observe(time.monotonic() - t_admit, tags=self._slo_tags)
         return slot
 
     async def _reserve(self, prompt_ids, total_len: int,
@@ -1075,4 +1115,12 @@ class LLMServer:
             s["prefix_query_tokens"] = mgr.prefix_query_tokens
             s["prefix_hit_rate"] = round(
                 mgr.prefix_hit_tokens / max(mgr.prefix_query_tokens, 1), 4)
+        from ray_tpu.util import metrics as _metrics
+        s["slo"] = {
+            "ttft_s": _metrics.histogram_summary("serve_ttft_s"),
+            "tpot_ms": _metrics.histogram_summary("serve_tpot_ms"),
+            "batch_occupancy": _metrics.histogram_summary(
+                "serve_batch_occupancy"),
+            "kv_page_util": _metrics.histogram_summary("serve_kv_page_util"),
+        }
         return s

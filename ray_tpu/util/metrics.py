@@ -156,6 +156,54 @@ def clear_registry():
         _registry.clear()
 
 
+def _bucket_quantile(q: float, bounds: List[float], buckets: List[int],
+                     total: int) -> float:
+    """Prometheus-style histogram_quantile: walk the cumulative bucket
+    counts and linearly interpolate inside the bucket the rank falls in.
+    The overflow bucket clamps to the highest bound (no upper edge)."""
+    rank = q * total
+    cum = 0
+    for i, n in enumerate(buckets):
+        if n == 0:
+            continue
+        if cum + n >= rank:
+            if i >= len(bounds):           # overflow bucket: clamp
+                return bounds[-1] if bounds else 0.0
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i]
+            frac = (rank - cum) / n
+            return lo + (hi - lo) * frac
+        cum += n
+    return bounds[-1] if bounds else 0.0
+
+
+def histogram_summary(name: str,
+                      qs: Sequence[float] = (0.5, 0.9, 0.99)
+                      ) -> Optional[Dict[str, float]]:
+    """Quantile summary of a registered Histogram, merged across ALL its
+    tag series: {"count", "sum", "mean", "p50", "p90", "p99"} (keys follow
+    `qs`). None when the histogram doesn't exist or has no observations —
+    callers render '-' rather than a fake zero."""
+    with _registry_lock:
+        m = _registry.get(name)
+    if not isinstance(m, Histogram):
+        return None
+    snap = m.snapshot()
+    bounds = snap["boundaries"]
+    merged = [0] * (len(bounds) + 1)
+    for series in snap["buckets"].values():
+        for i, n in enumerate(series):
+            merged[i] += n
+    total = sum(merged)
+    if total == 0:
+        return None
+    s = sum(snap["sum"].values())
+    out = {"count": total, "sum": s, "mean": s / total}
+    for q in qs:
+        out[f"p{int(q * 100)}"] = _bucket_quantile(q, bounds, merged, total)
+    return out
+
+
 # -- control-plane transport counters ---------------------------------------
 # The raw tallies live in _private/protocol.py (imported during
 # ray_tpu/__init__, so it cannot depend on this package); these helpers are

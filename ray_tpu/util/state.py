@@ -71,6 +71,18 @@ def summarize_actors() -> Dict[str, int]:
     return out
 
 
+def cluster_health() -> Dict[str, Any]:
+    """The /api/cluster aggregate: per-node health rows (dead nodes kept as
+    tombstones), resource totals, queue state, alert tail, current leaks."""
+    return _snapshot("cluster_health")
+
+
+def list_alerts(limit: int = 100) -> List[Dict]:
+    """Chronological threshold-rule alert events (store pressure, node
+    death, heartbeat silence, queue growth, object leaks)."""
+    return _snapshot("alerts")[-limit:]
+
+
 def summarize_objects() -> Dict[str, Any]:
     objs = _snapshot("objects")
     by_loc: Dict[str, int] = {}

@@ -182,6 +182,16 @@ def record_span(name: str, cat: str, trace_id: Optional[str],
     buf = _buf
     if len(buf) == buf.maxlen:
         _dropped += 1
+        # ring overwrite is silent data loss — surface it as a counter so
+        # scrapes see eviction pressure (only this degraded path pays the
+        # registry lookup; get_or_create stays valid across clear_registry)
+        try:
+            from . import metrics
+            metrics.get_or_create(
+                metrics.Counter, "tracing_spans_dropped",
+                "spans evicted from the trace ring before drain").inc()
+        except Exception:  # noqa: BLE001 - tracing must never raise
+            pass
     buf.append((name, cat, trace_id, span_id, parent_id, ts, dur, tid, args))
 
 

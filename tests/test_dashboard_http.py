@@ -37,8 +37,10 @@ def test_metrics_prometheus_exposition(dash):
     assert "# TYPE ray_tpu_workers gauge" in text
     assert "ray_tpu_resource_total{resource=\"CPU\"}" in text
     # controller-registry series fetched over the state RPC: the head
-    # counts async result applications, so a completed task must show up
-    assert "# TYPE result_async_tasks counter" in text
+    # counts async result applications, so a completed task must show up;
+    # counters carry the conformant _total suffix
+    assert "# TYPE result_async_tasks_total counter" in text
+    assert "# TYPE result_async_tasks counter" not in text
 
     # structural invariants: every sample line's metric name has a TYPE
     typed = {ln.split()[2] for ln in text.splitlines()
@@ -104,12 +106,156 @@ def test_task_state_rows_carry_phases(dash):
     assert all(v >= 0 for v in ph.values())
 
 
+def _parse_prometheus(text):
+    """Minimal text-format 0.0.4 parser: returns (types, samples) where
+    samples is [(name, {label: value}, float)]. Raises on malformed lines
+    — the round-trip test feeds it nasty label values."""
+    import re
+    types = {}
+    samples = []
+    label_re = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+    def unescape(v):
+        out, i = [], 0
+        while i < len(v):
+            if v[i] == "\\" and i + 1 < len(v):
+                out.append({"n": "\n", "\\": "\\", '"': '"'}
+                           .get(v[i + 1], v[i + 1]))
+                i += 2
+            else:
+                out.append(v[i])
+                i += 1
+        return "".join(out)
+
+    for ln in text.splitlines():
+        if not ln:
+            continue
+        if ln.startswith("# TYPE "):
+            _, _, name, mtype = ln.split(None, 3)
+            assert name not in types, f"duplicate TYPE for {name}"
+            types[name] = mtype
+            continue
+        if ln.startswith("#"):
+            continue
+        m = re.match(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{(.*)\})? (\S+)$", ln)
+        assert m, f"unparseable sample line: {ln!r}"
+        name, _, labels_raw, value = m.groups()
+        labels = {}
+        if labels_raw:
+            consumed = ",".join(f'{k}="{v}"'
+                                for k, v in label_re.findall(labels_raw))
+            assert consumed == labels_raw, f"bad label syntax: {labels_raw!r}"
+            labels = {k: unescape(v) for k, v in label_re.findall(labels_raw)}
+        samples.append((name, labels, float(value)))
+    return types, samples
+
+
+def test_prometheus_label_escaping_round_trip():
+    """Nasty label values (backslash, quote, newline) survive render →
+    parse; HELP/TYPE appear once per family even across merged registries;
+    counters get the _total suffix exactly once."""
+    from ray_tpu.dashboard import _prometheus_text
+
+    nasty = 'a\\b"c\nd'
+    snaps = [
+        {"type": "counter", "name": "rt_evil", "description": 'has "quotes"',
+         "values": {(("tag", nasty),): 3.0}},
+        # same family from a second registry: samples merge, no second TYPE
+        {"type": "counter", "name": "rt_evil", "description": 'has "quotes"',
+         "values": {(("tag", "plain"),): 1.0}},
+        # already-suffixed counter must not become _total_total
+        {"type": "counter", "name": "rt_done_total", "description": "",
+         "values": {(): 2.0}},
+        {"type": "gauge", "name": "rt_gauge", "description": "",
+         "values": {(("node", "n\\1"),): 7.5}},
+        {"type": "histogram", "name": "rt_hist", "description": "h",
+         "boundaries": [1.0, 2.0], "buckets": {(("k", 'q"v'),): [1, 2, 3]},
+         "sum": {(("k", 'q"v'),): 9.0}, "count": {(("k", 'q"v'),): 6}},
+    ]
+    text = _prometheus_text(snaps)
+    types, samples = _parse_prometheus(text)
+    assert types["rt_evil_total"] == "counter"
+    assert "rt_evil" not in types
+    assert types["rt_done_total"] == "counter"
+    assert "rt_done_total_total" not in types
+    assert text.count("# TYPE rt_evil_total") == 1
+    assert text.count("# HELP rt_evil_total") == 1
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    # the escaped value round-trips to the original bytes
+    assert ({"tag": nasty}, 3.0) in by_name["rt_evil_total"]
+    assert ({"tag": "plain"}, 1.0) in by_name["rt_evil_total"]
+    assert ({"node": "n\\1"}, 7.5) in by_name["rt_gauge"]
+    # histogram structure: cumulative buckets + +Inf terminator
+    buckets = by_name["rt_hist_bucket"]
+    assert ({"k": 'q"v', "le": "1.0"}, 1.0) in buckets
+    assert ({"k": 'q"v', "le": "2.0"}, 3.0) in buckets
+    assert ({"k": 'q"v', "le": "+Inf"}, 6.0) in buckets
+    assert by_name["rt_hist_sum"] == [({"k": 'q"v'}, 9.0)]
+    assert by_name["rt_hist_count"] == [({"k": 'q"v'}, 6.0)]
+
+
+def test_live_scrape_parses_clean(dash):
+    """The real /api/metrics payload round-trips through the parser: every
+    line well-formed, every TYPE unique, every counter family _total."""
+    ray, base = dash
+    ray.get(ray.remote(lambda: 1).remote())
+    _, body = _get(base, "/api/metrics")
+    types, samples = _parse_prometheus(body.decode())
+    assert samples
+    for name, mtype in types.items():
+        if mtype == "counter":
+            assert name.endswith("_total"), name
+
+
+def test_cluster_health_endpoint(dash):
+    """/api/cluster aggregates per-node health rows + alerts + leaks."""
+    ray, base = dash
+    ray.get(ray.remote(lambda: 1).remote())
+    _, body = _get(base, "/api/cluster")
+    health = json.loads(body)
+    assert {"ts", "nodes", "resources", "queue", "alerts", "leaks"} \
+        <= set(health)
+    head = health["nodes"][0]
+    assert head["is_head"] and head["alive"]
+    assert {"queue_depth", "workers_busy", "workers_idle", "store_used",
+            "store_capacity", "store_objects"} <= set(head)
+    assert head["store_capacity"] > 0
+
+
+def test_alerts_endpoint(dash):
+    """/api/alerts serves the chronological alert event list (empty or
+    not, always a JSON list)."""
+    _, base = dash
+    hdrs, body = _get(base, "/api/alerts")
+    assert hdrs["Content-Type"].startswith("application/json")
+    events = json.loads(body)
+    assert isinstance(events, list)
+    for ev in events:
+        assert {"id", "ts", "kind", "key", "severity", "message"} <= set(ev)
+
+
 def test_unknown_route_is_404_json(dash):
     _, base = dash
     with pytest.raises(urllib.error.HTTPError) as ei:
         _get(base, "/api/nonsense")
     assert ei.value.code == 404
+    assert ei.value.headers["Content-Type"].startswith("application/json")
     assert "no route" in json.loads(ei.value.read())["error"]
+
+
+def test_handler_exception_is_500_json(dash):
+    """A handler exception surfaces as a JSON 500 (the /api/_boom test
+    hook raises), not a dropped connection or a text/plain traceback."""
+    _, base = dash
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(base, "/api/_boom")
+    assert ei.value.code == 500
+    assert ei.value.headers["Content-Type"].startswith("application/json")
+    payload = json.loads(ei.value.read())
+    assert "RuntimeError" in payload["error"]
+    assert "boom" in payload["traceback"]
 
 
 def test_bad_job_body_is_400(dash):

@@ -1,0 +1,350 @@
+"""Cluster health signal plane (ISSUE 11): object-lifetime ledger, leak
+detector, alert log, health gauges, quantile summaries, and the tracing
+drop counter. Threshold/age logic is tested with a fake clock — no sleeps.
+"""
+
+import os
+
+import pytest
+
+from ray_tpu._private import health
+from ray_tpu._private.task_spec import ObjectMeta
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+# ---------------------------------------------------------------- ledger
+def test_ledger_ages_full_lifecycle():
+    meta = ObjectMeta(object_id="obj-1", ts_created=100.0, ts_sealed=102.5,
+                      ts_pinned=103.0, ts_released=110.0, pinned=1)
+    ages = health.ledger_ages(meta, now=120.0)
+    assert ages["age_s"] == 20.0
+    assert ages["seal_latency_s"] == 2.5
+    assert ages["sealed_age_s"] == 17.5
+    assert ages["pinned_age_s"] == 17.0
+    assert ages["released_age_s"] == 10.0
+
+
+def test_ledger_ages_partial():
+    """Unsealed / unpinned objects only report what actually happened."""
+    meta = ObjectMeta(object_id="obj-2", ts_created=100.0)
+    ages = health.ledger_ages(meta, now=101.0)
+    assert ages == {"age_s": 1.0}
+    # pinned age only reported while actually pinned (ts stamp alone is
+    # not enough — _unpin clears it when the count returns to 0)
+    meta.ts_pinned = 100.5
+    assert "pinned_age_s" not in health.ledger_ages(meta, now=101.0)
+    meta.pinned = 2
+    assert health.ledger_ages(meta, now=101.0)["pinned_age_s"] == 0.5
+
+
+# ---------------------------------------------------------- leak detector
+def _objects(clock):
+    t = clock()
+    return {
+        # pinned far past the age threshold → "pinned" leak
+        "leak-pinned": ObjectMeta(
+            object_id="leak-pinned", size=64, location="shm", refcount=1,
+            pinned=2, creating_task="task-aaaa", ts_created=t - 100,
+            ts_sealed=t - 99, ts_pinned=t - 50),
+        # sealed long ago, refcount still held → "unreleased" leak
+        "leak-unreleased": ObjectMeta(
+            object_id="leak-unreleased", size=32, location="shm", refcount=1,
+            pinned=0, creating_task="task-bbbb", ts_created=t - 40,
+            ts_sealed=t - 39),
+        # young object: not flagged
+        "fresh": ObjectMeta(
+            object_id="fresh", size=8, location="shm", refcount=1,
+            creating_task="task-cccc", ts_created=t - 1, ts_sealed=t - 1),
+        # error tombstone: never flagged regardless of age
+        "errored": ObjectMeta(
+            object_id="errored", size=0, location="error", refcount=1,
+            ts_created=t - 500),
+    }
+
+
+def test_leak_detector_flags_with_owner_and_trace():
+    clock = FakeClock()
+    det = health.LeakDetector(age_s=10.0, clock=clock)
+    leaks = {l["object_id"]: l for l in det.scan(_objects(clock))}
+    assert set(leaks) == {"leak-pinned", "leak-unreleased"}
+    p = leaks["leak-pinned"]
+    assert p["reason"] == "pinned"
+    assert p["owner_task"] == "task-aaaa"
+    # default sampling derives the trace id from the task id itself
+    from ray_tpu.util import tracing
+    assert p["trace_id"] == tracing.trace_id_for("task-aaaa")
+    assert p["ledger"]["pinned_age_s"] == 50.0
+    u = leaks["leak-unreleased"]
+    assert u["reason"] == "unreleased"
+    assert u["owner_task"] == "task-bbbb"
+    assert u["ledger"]["age_s"] == 40.0
+
+
+def test_leak_detector_age_threshold_is_sharp():
+    clock = FakeClock()
+    det = health.LeakDetector(age_s=150.0, clock=clock)
+    objs = _objects(clock)
+    assert det.scan(objs) == []           # nothing older than 150s yet
+    clock.advance(60.0)                   # leak-pinned created 160s ago now
+    leaks = det.scan(objs)
+    assert [l["object_id"] for l in leaks] == ["leak-pinned"]
+    # the pinned rule (ts_pinned 110s ago) hasn't tripped — the age rule did
+    assert leaks[0]["reason"] == "unreleased"
+    clock.advance(60.0)                   # pinned-since now 170s ago
+    leaks = {l["object_id"]: l for l in det.scan(objs)}
+    assert leaks["leak-pinned"]["reason"] == "pinned"
+    assert leaks["leak-unreleased"]["reason"] == "unreleased"
+
+
+def test_leak_detector_env_knob(monkeypatch):
+    clock = FakeClock()
+    monkeypatch.setenv("RAY_TPU_LEAK_AGE_S", "20")
+    det = health.LeakDetector(clock=clock)   # age from env, read per scan
+    leaks = det.scan(_objects(clock))
+    assert {l["object_id"] for l in leaks} == {"leak-pinned",
+                                               "leak-unreleased"}
+    monkeypatch.setenv("RAY_TPU_LEAK_AGE_S", "1000")
+    assert det.scan(_objects(clock)) == []
+
+
+# -------------------------------------------------------------- alert log
+def test_alert_log_dedup_and_resolve():
+    clock = FakeClock()
+    log = health.AlertLog(maxlen=8, clock=clock)
+    ev = log.fire("store_pressure", "node-1", "store 95% full", used=95)
+    assert ev is not None and ev["data"]["used"] == 95
+    # same (kind, key) while active → deduped, no second event
+    assert log.fire("store_pressure", "node-1", "still full") is None
+    assert log.active_count() == 1
+    assert len(log.events()) == 1
+    # a different key is its own alert
+    assert log.fire("store_pressure", "node-2", "also full") is not None
+    # resolve re-arms: the recurrence is a fresh event
+    log.resolve("store_pressure", "node-1")
+    clock.advance(5.0)
+    ev2 = log.fire("store_pressure", "node-1", "full again")
+    assert ev2 is not None and ev2["ts"] == clock()
+    kinds = [(e["kind"], e["key"]) for e in log.events()]
+    assert kinds == [("store_pressure", "node-1"),
+                     ("store_pressure", "node-2"),
+                     ("store_pressure", "node-1")]
+
+
+def test_alert_log_bounded():
+    log = health.AlertLog(maxlen=4, clock=FakeClock())
+    for i in range(10):
+        log.fire("k", f"key-{i}", f"m{i}")
+    evs = log.events()
+    assert len(evs) == 4
+    assert [e["key"] for e in evs] == ["key-6", "key-7", "key-8", "key-9"]
+    assert log.events(limit=2)[-1]["key"] == "key-9"
+
+
+# ------------------------------------------------------------ queue rule
+class _StubController:
+    """Just enough controller for HealthMonitor.tick()."""
+
+    def __init__(self):
+        self.node_id = "head"
+        self.cluster = None
+        self.objects = {}
+
+    def health_snapshot(self):
+        return dict(self._snap)
+
+
+def test_queue_growth_rule(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_ALERT_QUEUE_INTERVALS", "3")
+    clock = FakeClock()
+    c = _StubController()
+    mon = health.HealthMonitor(c, clock=clock)
+    for depth in (1, 2, 3):             # 3 samples = 2 increases: not yet
+        c._snap = {"ts": clock(), "queue_depth": depth,
+                   "store_used": 0, "store_capacity": 100}
+        mon.tick()
+    assert mon.alerts.active_count() == 0
+    c._snap = {"ts": clock(), "queue_depth": 4,
+               "store_used": 0, "store_capacity": 100}
+    mon.tick()                          # 4 samples, strictly increasing
+    assert ("queue_growth", "head") in mon.alerts.active_keys()
+    c._snap = {"ts": clock(), "queue_depth": 0,
+               "store_used": 0, "store_capacity": 100}
+    mon.tick()                          # growth broken → resolved
+    assert mon.alerts.active_count() == 0
+
+
+def test_store_pressure_rule(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_ALERT_STORE_PCT", "90")
+    clock = FakeClock()
+    c = _StubController()
+    mon = health.HealthMonitor(c, clock=clock)
+    c._snap = {"ts": clock(), "queue_depth": 0,
+               "store_used": 95, "store_capacity": 100}
+    mon.tick()
+    assert ("store_pressure", "head") in mon.alerts.active_keys()
+    ev = mon.alerts.events()[-1]
+    assert ev["severity"] == "warning" and ev["data"]["used"] == 95
+    c._snap = {"ts": clock(), "queue_depth": 0,
+               "store_used": 10, "store_capacity": 100}
+    mon.tick()
+    assert mon.alerts.active_count() == 0
+
+
+def test_monitor_leak_rule_and_node_death(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LEAK_AGE_S", "10")
+    monkeypatch.setenv("RAY_TPU_LEAK_SCAN_S", "5")
+    clock = FakeClock()
+    c = _StubController()
+    c._snap = {"ts": clock(), "queue_depth": 0,
+               "store_used": 0, "store_capacity": 100}
+    c.objects = _objects(clock)
+    mon = health.HealthMonitor(c, clock=clock)
+    clock.advance(6.0)                  # past the scan interval
+    mon.tick()
+    assert {l["object_id"] for l in mon.leaks} == {"leak-pinned",
+                                                   "leak-unreleased"}
+    keys = mon.alerts.active_keys()
+    assert ("object_leak", "leak-pinned") in keys
+    ev = next(e for e in mon.alerts.events()
+              if e["key"] == "leak-pinned")
+    assert ev["data"]["owner_task"] == "task-aaaa"
+    assert ev["data"]["trace_id"]
+    # the leaked objects get released → next scan resolves their alerts
+    # (the "fresh" object's created-ts moves with the clock so it doesn't
+    # age across the threshold mid-test)
+    del c.objects["leak-pinned"], c.objects["leak-unreleased"]
+    clock.advance(6.0)
+    c.objects["fresh"].ts_created = clock()
+    c.objects["fresh"].ts_sealed = clock()
+    mon.tick()
+    assert not any(k == "object_leak" for k, _ in mon.alerts.active_keys())
+
+    # node death path: tombstone + critical alert, cleared on rejoin
+    mon.note_node_dead("node-x", host="h1")
+    assert mon.dead_nodes["node-x"]["alive"] is False
+    assert ("node_dead", "node-x") in mon.alerts.active_keys()
+    assert any(e["kind"] == "node_dead" and e["severity"] == "critical"
+               for e in mon.alerts.events())
+    mon.note_node_alive("node-x")
+    assert "node-x" not in mon.dead_nodes
+    assert ("node_dead", "node-x") not in mon.alerts.active_keys()
+
+
+def test_monitor_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_HEALTH", "0")
+    clock = FakeClock()
+    c = _StubController()
+    c._snap = {"ts": clock(), "queue_depth": 0,
+               "store_used": 100, "store_capacity": 100}
+    mon = health.HealthMonitor(c, clock=clock)
+    mon.tick()
+    assert mon.alerts.events() == []
+
+
+# ------------------------------------------------------ histogram summary
+def test_histogram_summary_quantiles():
+    from ray_tpu.util import metrics
+    name = "rt_test_summary_hist"
+    h = metrics.get_or_create(metrics.Histogram, name, "t",
+                              boundaries=[1.0, 2.0, 4.0],
+                              tag_keys=("engine",))
+    try:
+        for v in (0.5, 1.5, 1.6, 3.0):
+            h.observe(v, tags={"engine": "a"})
+        for v in (3.5, 100.0):
+            h.observe(v, tags={"engine": "b"})  # tag series merge
+        s = metrics.histogram_summary(name)
+        assert s["count"] == 6
+        assert s["sum"] == pytest.approx(110.1)
+        assert s["mean"] == pytest.approx(110.1 / 6)
+        # p50: rank 3 of [1, 2, 2, 1] buckets → inside (1, 2]
+        assert 1.0 <= s["p50"] <= 2.0
+        # p99 lands in the overflow bucket → clamped to the top bound
+        assert s["p99"] == 4.0
+        assert metrics.histogram_summary("rt_never_registered") is None
+    finally:
+        with metrics._registry_lock:
+            metrics._registry.pop(name, None)
+
+
+# ------------------------------------------------- in-process store gauges
+def test_head_health_snapshot_and_state_kinds(ray_session):
+    """state('cluster_health') / state('alerts') flow through the same
+    snapshot path as every other kind; the head row carries live store
+    gauges and objects rows carry the ledger."""
+    ray = ray_session
+    ref = ray.put(b"y" * 4096)
+    try:
+        from ray_tpu.util import state as state_api
+        health_view = state_api.cluster_health()
+        head = health_view["nodes"][0]
+        assert head["is_head"] and head["node_id"]
+        assert head["store_objects"] >= 1
+        assert head["store_capacity"] > 0
+        assert 0 <= head["worker_occupancy"] <= 1.0
+        assert isinstance(state_api.list_alerts(), list)
+        rows = {o["object_id"]: o for o in state_api.list_objects(limit=10000)}
+        row = rows[ref.id]
+        assert row["age_s"] >= 0.0
+        assert "sealed_age_s" in row            # ray.put seals immediately
+    finally:
+        del ref
+
+
+def test_store_alloc_failure_counter(monkeypatch):
+    """A failing shm allocation bumps the module counter (and the metric)
+    instead of passing silently."""
+    from multiprocessing import shared_memory
+
+    from ray_tpu._private import object_store
+
+    class _Boom:
+        def __init__(self, *a, **k):
+            raise OSError("no shm")
+
+    before = object_store.alloc_failures()
+    store = object_store.StoreClient.__new__(object_store.StoreClient)
+    store._slab = None
+    monkeypatch.setattr(shared_memory, "SharedMemory", _Boom)
+    monkeypatch.setattr(object_store, "shared_memory", shared_memory,
+                        raising=False)
+    with pytest.raises(OSError):
+        store._new_segment("obj-fail-test", 128)
+    assert object_store.alloc_failures() == before + 1
+
+
+# ----------------------------------------------------- tracing drop stat
+def test_tracing_spans_dropped_counter(monkeypatch):
+    from ray_tpu.util import metrics, tracing
+    monkeypatch.setenv("RAY_TPU_TRACE", "1")
+    monkeypatch.setenv("RAY_TPU_TRACE_BUFFER", "16")
+    tracing.refresh()
+    tracing.clear()
+
+    def total():
+        with metrics._registry_lock:
+            m = metrics._registry.get("tracing_spans_dropped")
+        return sum(m.snapshot()["values"].values()) if m else 0.0
+
+    t0 = total()
+    for i in range(16):
+        tracing.record_span(f"s{i}", "t", None, i, None, 0.0, 0.0)
+    assert tracing.summary()["dropped"] == 0
+    assert total() == t0
+    for i in range(5):
+        tracing.record_span(f"over{i}", "t", None, i, None, 0.0, 0.0)
+    assert tracing.summary()["dropped"] == 5
+    assert total() == t0 + 5
+    tracing.clear()
+    assert tracing.summary()["dropped"] == 0
