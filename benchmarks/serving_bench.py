@@ -13,7 +13,12 @@ KV. One JSON line:
   {"dense": {"decode_tps": .., "ttft_p50_ms": .., "ttft_p99_ms": ..,
              "tokens_per_sync": ..},
    "paged": {...}, "B": .., "decode_chunk": .., "backend": ..}
-SECTIONS=dense,paged,prefix,speculative selects sections (all by default).
+SECTIONS=dense,paged,prefix,speculative,pd selects sections (all by
+default). The `pd` section runs disaggregated prefill/decode on a
+shared-prefix workload, streaming KV plane vs the legacy KV-over-RPC
+hand-off. `--smoke` is the tier-1 CPU gate for the streaming plane:
+asserts the kv_ship counters moved and that no KV bytes rode the RPC
+control frames.
 """
 
 import asyncio
@@ -46,7 +51,7 @@ MAX_TOKENS = int(os.environ.get("MAX_TOKENS", 48))
 PROMPT_LEN = int(os.environ.get("PROMPT_LEN", 64))
 ROUNDS = int(os.environ.get("ROUNDS", 3))
 SECTIONS = set(s.strip() for s in os.environ.get(
-    "SECTIONS", "dense,paged,prefix,speculative").split(",") if s.strip())
+    "SECTIONS", "dense,paged,prefix,speculative,pd").split(",") if s.strip())
 
 
 def bench_mode(paged: bool):
@@ -194,6 +199,180 @@ def bench_speculative():
                              max(plain["decode_tps"], 1e-9), 2)}
 
 
+class _WireMethod:
+    """DeploymentHandle-shaped method whose every call crosses a pickle
+    boundary in BOTH directions — the minimum any cross-process RPC pays
+    (the real control plane additionally pays a socket). KV arrays riding
+    inside a frame get fully serialized and copied; the contents of shm
+    segments never enter a frame, which is exactly the asymmetry the
+    streaming plane is built on."""
+
+    def __init__(self, fn):
+        self._fn = fn
+
+    def remote(self, *a, **kw):
+        import pickle
+        blob = pickle.dumps((a, kw), protocol=5)
+
+        async def go():
+            a2, kw2 = pickle.loads(blob)
+            out = await self._fn(*a2, **kw2)
+            return pickle.loads(bytes(pickle.dumps(out, protocol=5)))
+
+        return go()
+
+
+class _WirePrefill:
+    """In-process stand-in for a remote prefill replica (quacks like a
+    serve DeploymentHandle, so PDServer takes its non-direct call path)."""
+
+    def __init__(self, srv):
+        for name in ("prefill_begin", "prefill_wait", "prefill_fetch",
+                     "prefill_drop", "prefill_kv"):
+            setattr(self, name, _WireMethod(getattr(srv, name)))
+
+
+def bench_pd():
+    """Disaggregated prefill/decode on a high-prefix-overlap workload:
+    every request shares a long base prompt and differs in a 3-token tail,
+    with a short decode (the TTFT-bound regime disaggregation targets).
+    Runs the SAME workload twice — the streaming KV-page plane (default)
+    vs the legacy whole-KV-in-the-RPC hand-off (RAY_TPU_KV_SHIP=0) — and
+    reports tokens/s, TTFT, the counter deltas, and the fraction of pages
+    the prefix-aware ship never had to move. The hand-off crosses a
+    _WirePrefill pickle boundary both ways so frame payload size has its
+    real cost; on CPU the tiny preset's KV is widened (model_overrides)
+    to an LLM-realistic ~4 KiB/token so the hand-off isn't measurement
+    noise next to the toy model's compute."""
+    import jax
+
+    from ray_tpu.serve.llm import LLMConfig
+    from ray_tpu.serve.pd import PDServer, PrefillServer
+    from ray_tpu.util import metrics as _metrics
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    page = 64 if on_tpu else 16
+    plen = max(PROMPT_LEN, (8 if on_tpu else 32) * page)
+    gen_tokens = int(os.environ.get("PD_MAX_TOKENS", 4))
+
+    def cfg():
+        return LLMConfig(preset="llama_125m" if on_tpu else "tiny",
+                         max_batch_slots=B,
+                         max_seq_len=plen + gen_tokens + 2 * page,
+                         paged=True, page_size=page, prefill_chunk=64,
+                         prefix_cache=True,
+                         model_overrides=None if on_tpu else dict(
+                             n_layers=4, n_kv_heads=4, n_heads=4,
+                             head_dim=64, max_seq_len=plen + 64))
+
+    base = list(range(1, plen - 3))
+
+    def run(ship: bool):
+        prev = os.environ.get("RAY_TPU_KV_SHIP")
+        os.environ["RAY_TPU_KV_SHIP"] = "1" if ship else "0"
+        try:
+            prefill = PrefillServer(cfg())
+            pd = PDServer(cfg(), params=prefill.params,
+                          prefill=_WirePrefill(prefill))
+
+            async def one(i):
+                out = await pd.generate(base + [240 + (i % 8), 249, 250],
+                                        max_tokens=gen_tokens)
+                return out["ttft_s"], len(out["tokens"])
+
+            async def rnd(k):
+                return await asyncio.gather(
+                    *[one(k * B + j) for j in range(B)])
+
+            # two warm rounds: round 0 compiles the cold-prefill programs,
+            # round 1 the warm-cache suffix-chunk variants
+            asyncio.run(rnd(0))
+            asyncio.run(rnd(1))
+            c0 = _metrics.kv_ship_counters()
+            ttfts = []
+            toks = 0
+            t0 = time.perf_counter()
+            for r in range(ROUNDS):
+                for ttft, n in asyncio.run(rnd(r + 2)):
+                    ttfts.append(ttft)
+                    toks += n
+            dt = time.perf_counter() - t0
+            c1 = _metrics.kv_ship_counters()
+            ttfts.sort()
+            rec = {"tokens_per_s": round(toks / dt, 1),
+                   "ttft_p50_ms": round(ttfts[len(ttfts) // 2] * 1e3, 1),
+                   "requests": len(ttfts)}
+            if ship:
+                rec["kv_ship"] = {k: round(c1[k] - c0[k], 1) for k in c1}
+            return rec
+        finally:
+            if prev is None:
+                os.environ.pop("RAY_TPU_KV_SHIP", None)
+            else:
+                os.environ["RAY_TPU_KV_SHIP"] = prev
+
+    stream = run(True)
+    rpc = run(False)
+    shipped = stream["kv_ship"]["pages"]
+    saved = stream["kv_ship"]["saved_pages"]
+    return {"stream": stream, "rpc": rpc,
+            "stream_over_rpc": round(
+                stream["tokens_per_s"] / max(rpc["tokens_per_s"], 1e-9), 2),
+            "saved_page_fraction": round(
+                saved / max(saved + shipped, 1.0), 3)}
+
+
+def smoke() -> int:
+    """Tier-1 CPU gate (run as `serving_bench.py --smoke`): one tiny PD
+    round trip through the streaming plane, asserting the kv_ship counters
+    moved, the outputs match a colocated engine, and every control frame
+    is plain JSON metadata — i.e. zero KV bytes in the RPC plane."""
+    from ray_tpu.serve.llm import LLMConfig, LLMServer
+    from ray_tpu.serve.pd import PDServer, PrefillServer
+    from ray_tpu.util import metrics as _metrics
+
+    def cfg():
+        return LLMConfig(preset="tiny", max_batch_slots=2, max_seq_len=96,
+                         paged=True, page_size=16, prefill_chunk=32,
+                         prefix_cache=True, seed=0)
+
+    prefill = PrefillServer(cfg())
+    pd = PDServer(cfg(), params=prefill.params, prefill=prefill)
+    ref = LLMServer(cfg(), params=prefill.params)
+    prompt = list(range(3, 40))
+    frames = []
+
+    async def drive():
+        # raw control-plane drive first: capture every frame the decode
+        # side would see
+        header = await prefill.prefill_begin(prompt)
+        frames.append(header)
+        have, done = 0, False
+        while not done:
+            res = await prefill.prefill_wait(header["ship_id"], have)
+            frames.append(res)
+            have += len(res["segments"])
+            done = res["done"]
+        await prefill.prefill_drop(header["ship_id"])
+        # then end-to-end parity through the public path
+        a = await pd.generate(prompt, max_tokens=6)
+        b = await ref.generate(prompt, max_tokens=6)
+        assert a["tokens"] == b["tokens"], (a["tokens"], b["tokens"])
+
+    asyncio.run(drive())
+    # json.dumps raises on any ndarray/bytes — the zero-KV-in-RPC proof
+    blob = json.dumps(frames)
+    c = _metrics.kv_ship_counters()
+    assert c["bytes"] > 0 and c["pages"] > 0, c
+    assert c["segments"] > 0 and c["requests"] > 0, c
+    assert c["attach_hits"] + c["stream_pulls"] + c["rpc_pulls"] > 0, c
+    assert c["rpc_fallback_bytes"] == 0, c
+    assert len(blob) < 8192, f"control frames suspiciously large: {len(blob)}"
+    print(json.dumps({"smoke": "ok", "kv_ship": c,
+                      "frame_bytes": len(blob)}))
+    return 0
+
+
 def main():
     import jax
     from bench import _INIT_SENTINEL  # repo root is on sys.path (line 17)
@@ -221,11 +400,21 @@ def main():
             out["speculative"] = bench_speculative()
         except Exception as e:  # noqa: BLE001 - record the failure, continue
             out["speculative"] = {"error": repr(e)[:200]}
+    if "pd" in SECTIONS:
+        try:
+            out["pd"] = bench_pd()
+        except Exception as e:  # noqa: BLE001 - record the failure, continue
+            out["pd"] = {"error": repr(e)[:200]}
     print(json.dumps(out))
 
 
 if __name__ == "__main__":
-    if "--measure" in sys.argv[1:]:
+    if "--smoke" in sys.argv[1:]:
+        # the gate pins CPU itself so the tier-1 hook can't hang on
+        # accelerator init (the env must be set before jax imports)
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        sys.exit(smoke())
+    elif "--measure" in sys.argv[1:]:
         main()
     else:
         # parent mode: resilience ladder (accel rung + CPU-scrub rung)

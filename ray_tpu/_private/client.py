@@ -475,9 +475,14 @@ class DriverClient(BaseClient):
         return out
 
     def timeline(self):
+        from ray_tpu.util import tracing
         from .controller import format_timeline
-        return self._call_soon(
+        evts = self._call_soon(
             lambda: format_timeline(self.controller.timeline_events))
+        # merge the DRIVER process's own span ring: serve engines hosted
+        # in the driver (PD demos, tests, bench) record serve.* spans here,
+        # and no heartbeat ever ships this process's ring
+        return evts + tracing.to_chrome(tracing.events())
 
 
 class WorkerClient(BaseClient):

@@ -143,11 +143,16 @@ class StoreClient:
     keeps the object alive; don't stash the buffers past the ref.
     """
 
-    def __init__(self, create_arena: bool = False):
+    def __init__(self, create_arena: bool = False, backend: str = "auto"):
+        """`backend="pershm"` forces per-object POSIX segments regardless of
+        the session arena — planes that attach segments cross-process by
+        *name* (the serve KV-ship data plane) need it, since slab offsets
+        are private to each process's arena mapping."""
         self._attached = {}  # object_id -> LocalObject (pins shm while in use)
         self._slab = None
         arena = os.environ.get("RAY_TPU_ARENA")
-        if arena and os.environ.get("RAY_TPU_STORE_BACKEND") != "pershm":
+        if (arena and backend != "pershm"
+                and os.environ.get("RAY_TPU_STORE_BACKEND") != "pershm"):
             try:
                 from ray_tpu._native.store import SlabStore
                 capacity = int(os.environ.get("RAY_TPU_STORE_BYTES", 8 << 30))

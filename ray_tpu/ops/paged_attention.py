@@ -544,6 +544,25 @@ class PageManager:
         row = self.tables[slot]
         return row + [0] * (self.max_pages_per_seq - len(row))
 
+    def table_slice(self, slot: int, start: int, n: int):
+        """Page ids covering the slot's pages [start, start+n) — the PD
+        KV-ship plane's extraction/install unit. Host-side bookkeeping is
+        authoritative here, so suffix-delta shipping never pays a device
+        sync just to learn which pool rows hold a chunk's pages."""
+        row = self.tables[slot][start:start + n]
+        if len(row) != n:
+            raise IndexError(
+                f"slot {slot} holds {len(self.tables[slot])} pages, "
+                f"requested [{start}, {start + n})")
+        return list(row)
+
+    def shared_page_count(self, slot: int) -> int:
+        """Leading pages this slot borrowed from the prefix cache (their
+        KV is already resident — a PD decode replica needs only the
+        suffix pages shipped, a PD prefill replica skips recomputing
+        them)."""
+        return self._shared_count[slot]
+
     @property
     def pages_in_use(self) -> int:
         return (self.num_pages - 1) - len(self.free_pages)

@@ -1,0 +1,376 @@
+"""Zero-copy KV-page shipment plane for prefill/decode disaggregation.
+
+The legacy PD hand-off returned each request's full prompt KV as host
+numpy arrays inside the actor-RPC reply — a serialized copy of the
+entire prompt cache on the hot path. This module turns the hand-off
+into a streaming data plane over the object store:
+
+  * the PREFILL side seals extracted KV pages into per-object shm
+    segments (``StoreClient.create_writable`` → fill → seal, plasma
+    Create/Seal semantics) and puts only segment *metadata* in the RPC
+    frame (oid, byte count, page range — a few hundred bytes);
+  * the DECODE side pulls each segment the cheapest way available:
+    same-host it attaches the segment by name (zero copies end to end —
+    the install scatter reads straight out of the prefill replica's shm
+    pages); cross-host it rides ``node_agent.parallel_fetch``'s
+    4-stream ranged transfer into a local segment; and when neither
+    plane is reachable it falls back to a raw-bytes RPC fetch.
+
+Segments are published per prefill CHUNK, so the decode pull of chunk i
+overlaps the prefill compute of chunk i+1 — the serving-side analog of
+the r8 prefetch/execute overlap.
+
+Naming: ``object_store.seg_name`` keeps only the oid's last 16 chars,
+so ship oids are exactly 16 chars — an 8-hex per-process tag, a 4-hex
+ship counter, a 3-hex segment index, and one role suffix. The storage
+segment (``…s``) and the wire id served to remote pullers (``…w``)
+differ in that suffix so a same-host puller forced onto the remote path
+can never clobber the writer's live segment when ``parallel_fetch``
+lands the copy under the wire id.
+"""
+
+import asyncio
+import itertools
+import os
+import socket as _socket
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_tpu._private.object_store import StoreClient, seg_name
+from ray_tpu.util import metrics as _metrics
+
+_proc_tag = os.urandom(4).hex()          # 8 chars, fresh per process
+_ship_counter = itertools.count(1)
+
+
+def kv_ship_enabled() -> bool:
+    """Streaming is the default; RAY_TPU_KV_SHIP=0 restores the legacy
+    KV-over-RPC hand-off (the bench's comparison baseline)."""
+    return os.environ.get("RAY_TPU_KV_SHIP", "1") != "0"
+
+
+def local_attach_enabled() -> bool:
+    """RAY_TPU_KV_ATTACH=0 disables the same-host zero-copy attach so
+    tests can force the parallel_fetch / RPC pull paths on one host."""
+    return os.environ.get("RAY_TPU_KV_ATTACH", "1") != "0"
+
+
+def new_ship_id() -> str:
+    return f"{_proc_tag}{next(_ship_counter) & 0xFFFF:04x}"
+
+
+def _seg_base(ship_id: str, seg_index: int) -> str:
+    return f"{ship_id}{seg_index & 0xFFF:03x}"
+
+
+def storage_oid(ship_id: str, seg_index: int) -> str:
+    return _seg_base(ship_id, seg_index) + "s"
+
+
+def wire_oid(ship_id: str, seg_index: int) -> str:
+    return _seg_base(ship_id, seg_index) + "w"
+
+
+def _counter(name: str, desc: str) -> "_metrics.Counter":
+    return _metrics.get_or_create(_metrics.Counter, name, desc)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype by name, including the ml_dtypes extension types (the KV
+    pools are usually bfloat16, which np.dtype() can't resolve by string)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def _as_bytes(arr: np.ndarray) -> memoryview:
+    """Flat byte view of a C-contiguous array; works for extension dtypes
+    (bfloat16) that memoryview() itself refuses to export."""
+    return memoryview(arr.view(np.uint8)).cast("B")
+
+
+class ShipWriter:
+    """Prefill-side segment publisher over a pershm StoreClient.
+
+    pershm is forced regardless of the session arena: decode attaches
+    segments cross-process by NAME, and slab offsets are meaningless
+    outside the owning process's arena mapping."""
+
+    def __init__(self):
+        self.store = StoreClient(backend="pershm")
+        self._sizes: Dict[str, int] = {}       # storage oid -> nbytes
+        self._ship_oids: Dict[str, List[str]] = {}  # ship -> storage oids
+
+    def publish(self, ship_id: str, seg_index: int, k_pages: np.ndarray,
+                v_pages: np.ndarray, page_start: int) -> Dict[str, Any]:
+        """Seal one segment (k block then v block, each [L,Kh,n,ps,D]
+        C-contiguous) and return its wire metadata."""
+        k_pages = np.ascontiguousarray(k_pages)
+        v_pages = np.ascontiguousarray(v_pages)
+        nbytes = k_pages.nbytes + v_pages.nbytes
+        oid = storage_oid(ship_id, seg_index)
+        handle = self.store.create_writable(oid, nbytes)
+        try:
+            handle.view[:k_pages.nbytes] = _as_bytes(k_pages)
+            handle.view[k_pages.nbytes:nbytes] = _as_bytes(v_pages)
+        except BaseException:
+            handle.abort()
+            raise
+        handle.seal()
+        self._sizes[oid] = nbytes
+        self._ship_oids.setdefault(ship_id, []).append(oid)
+        n_pages = int(k_pages.shape[2])
+        _counter("kv_ship_bytes", "KV bytes sealed for PD shipment").inc(
+            nbytes)
+        _counter("kv_ship_pages", "KV pages sealed for PD shipment").inc(
+            n_pages)
+        _counter("kv_ship_segments", "KV shipment segments sealed").inc()
+        return {"seg": seg_index, "oid": oid,
+                "wire": wire_oid(ship_id, seg_index), "nbytes": nbytes,
+                "page_start": int(page_start), "n_pages": n_pages}
+
+    def read_segment(self, oid: str) -> bytes:
+        """Raw bytes for the RPC fetch fallback (the one path that puts
+        KV bytes back in an RPC frame — used only when both the shm
+        attach and the data-server pull are unavailable)."""
+        if oid not in self._sizes:
+            raise KeyError(f"unknown kv segment {oid}")
+        _counter("kv_ship_rpc_fallback_bytes",
+                 "KV bytes served via the RPC fetch fallback").inc(
+                     self._sizes[oid])
+        return self.store.read_raw(oid)
+
+    def size_of(self, oid: str) -> Optional[int]:
+        return self._sizes.get(oid)
+
+    def drop_ship(self, ship_id: str) -> None:
+        """Free every segment of one shipment (decode finished installing,
+        or the request failed)."""
+        for oid in self._ship_oids.pop(ship_id, []):
+            self._sizes.pop(oid, None)
+            try:
+                self.store.delete_segment(oid)
+            except Exception:  # noqa: BLE001 - already gone is fine
+                pass
+
+    def close(self) -> None:
+        for ship_id in list(self._ship_oids):
+            self.drop_ship(ship_id)
+
+
+class KVDataServer:
+    """Serves sealed KV segments over the ObjectDataServer wire protocol
+    (``RTPU1 <token>`` auth, then ranged ``GET <oid> <offset> <length>``)
+    so ``node_agent.parallel_fetch`` multi-stream pulls work against a
+    serve replica that has no controller object-table entry. Requests
+    name the segment's WIRE id; the server translates to the storage
+    segment before reading."""
+
+    _DATA_CHUNK = 1 << 20
+
+    def __init__(self, writer: ShipWriter):
+        self._writer = writer
+        self.addr = ""
+        self.serve_bytes = 0
+        self._server = None
+
+    async def start(self, host: Optional[str] = None) -> str:
+        host = host or os.environ.get("RAY_TPU_KV_HOST", "127.0.0.1")
+        self._server = await asyncio.start_server(self._on_client, host, 0)
+        port = self._server.sockets[0].getsockname()[1]
+        adv = _socket.gethostname() if host not in (
+            "127.0.0.1", "localhost", "::1") else "127.0.0.1"
+        self.addr = f"{adv}:{port}"
+        return self.addr
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            self._server = None
+
+    def _resolve(self, oid: str) -> Optional[str]:
+        if oid.endswith("w"):
+            storage = oid[:-1] + "s"
+            if self._writer.size_of(storage) is not None:
+                return storage
+        return None
+
+    async def _on_client(self, reader, writer):
+        import hmac
+
+        from ray_tpu._private.cluster import cluster_token
+        try:
+            hello = await asyncio.wait_for(reader.readline(), timeout=10)
+            expect = f"RTPU1 {cluster_token()}\n".encode()
+            if not hmac.compare_digest(hello, expect):
+                writer.close()
+                return
+            while True:
+                line = await reader.readline()
+                if not line:
+                    break
+                parts = line.decode("ascii", "replace").split()
+                if parts[:1] != ["GET"] or len(parts) != 4:
+                    break
+                await self._serve_range(writer, parts[1], int(parts[2]),
+                                        int(parts[3]))
+        except (OSError, asyncio.TimeoutError, UnicodeDecodeError, ValueError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except OSError:
+                pass
+
+    async def _serve_range(self, writer, oid: str, offset: int, length: int):
+        storage = self._resolve(oid)
+        size = self._writer.size_of(storage) if storage else None
+        if (size is None or offset < 0 or length <= 0
+                or offset + length > size):
+            writer.write(b"MISS\n")
+            await writer.drain()
+            return
+        try:
+            blob = self._writer.store.read_range(storage, offset, length)
+        except Exception:  # noqa: BLE001 - segment vanished under us
+            writer.write(b"MISS\n")
+            await writer.drain()
+            return
+        writer.write(f"OK {len(blob)}\n".encode("ascii"))
+        for i in range(0, len(blob), self._DATA_CHUNK):
+            writer.write(blob[i:i + self._DATA_CHUNK])
+            await writer.drain()  # backpressure per chunk
+        self.serve_bytes += len(blob)
+
+
+# Mappings whose close() hit a live export — the CPU jax client releases
+# an aliased upload buffer asynchronously, so the detach can trail the
+# install by a few events. Holding the handle here (instead of dropping it)
+# keeps SharedMemory.__del__ from raising at GC; each later close attempt
+# retries the pool.
+_pending_close: List[Any] = []
+
+
+def _drain_pending_close() -> None:
+    still = []
+    for shm in _pending_close:
+        try:
+            shm.close()
+        except BufferError:
+            still.append(shm)
+    _pending_close[:] = still
+
+
+def _final_drain() -> None:
+    import gc
+    gc.collect()  # collect dead device buffers so their exports release
+    _drain_pending_close()
+
+
+import atexit  # noqa: E402  (registration belongs right next to the pool)
+
+atexit.register(_final_drain)
+
+
+class AttachedSegment:
+    """One pulled segment exposed as zero-copy [L,Kh,n,ps,D] k/v arrays.
+
+    Close ONLY after the install consumed the arrays; a pulled local
+    copy (delete=True) is unlinked on close, a direct attach to the
+    writer's segment is merely detached (the writer owns deletion)."""
+
+    def __init__(self, k: np.ndarray, v: np.ndarray, shm=None,
+                 store: Optional[StoreClient] = None,
+                 oid: Optional[str] = None, delete: bool = False):
+        self.k = k
+        self.v = v
+        self._shm = shm
+        self._store = store
+        self._oid = oid
+        self._delete = delete
+
+    def close(self) -> None:
+        self.k = None
+        self.v = None
+        if self._delete and self._store is not None and self._oid:
+            # unlink the name now — the open mapping stays valid (POSIX),
+            # and the reclaim must not depend on the detach below landing
+            self._store.delete_segment(self._oid)
+            self._delete = False
+        if self._shm is not None:
+            shm, self._shm = self._shm, None
+            try:
+                shm.close()
+            except BufferError:
+                _pending_close.append(shm)
+        _drain_pending_close()
+
+
+def _carve(buf, seg: Dict[str, Any], layout, dtype) -> Tuple[np.ndarray,
+                                                             np.ndarray]:
+    """Split one segment's bytes into the k and v page blocks."""
+    L, Kh, ps, D = layout
+    n = seg["n_pages"]
+    shape = (L, Kh, n, ps, D)
+    half = seg["nbytes"] // 2
+    k = np.frombuffer(buf, dtype=dtype, count=half // dtype.itemsize)
+    v = np.frombuffer(buf, dtype=dtype, count=half // dtype.itemsize,
+                      offset=half)
+    return k.reshape(shape), v.reshape(shape)
+
+
+class ShipReader:
+    """Decode-side segment puller. One per decode replica; owns a pershm
+    StoreClient that parallel_fetch lands remote segments into."""
+
+    def __init__(self):
+        self.store = StoreClient(backend="pershm")
+
+    async def fetch(self, seg: Dict[str, Any], layout, dtype_name: str,
+                    data_addr: Optional[str] = None,
+                    rpc_fetch=None) -> AttachedSegment:
+        """Materialize one segment: shm attach → parallel_fetch → RPC."""
+        dtype = _np_dtype(dtype_name)
+        if local_attach_enabled():
+            att = self._attach(seg["oid"], seg, layout, dtype, delete=False)
+            if att is not None:
+                _counter("kv_ship_attach_hits",
+                         "KV segments attached zero-copy same-host").inc()
+                return att
+        if data_addr:
+            from ray_tpu._private.node_agent import parallel_fetch
+            got = await parallel_fetch([data_addr], seg["wire"],
+                                       seg["nbytes"], 0, (), self.store)
+            if got is not None:
+                att = self._attach(seg["wire"], seg, layout, dtype,
+                                   delete=True)
+                if att is not None:
+                    _counter("kv_ship_stream_pulls",
+                             "KV segments pulled via parallel_fetch").inc()
+                    return att
+        if rpc_fetch is not None:
+            blob = await rpc_fetch(seg["oid"])
+            k, v = _carve(blob, seg, layout, dtype)
+            _counter("kv_ship_rpc_pulls",
+                     "KV segments fetched via the RPC fallback").inc()
+            return AttachedSegment(k, v)
+        raise RuntimeError(
+            f"kv segment {seg['oid']} unreachable: no shm attach, no data "
+            "server, no RPC fetch")
+
+    def _attach(self, oid: str, seg, layout, dtype,
+                delete: bool) -> Optional[AttachedSegment]:
+        from multiprocessing import shared_memory
+        try:
+            shm = shared_memory.SharedMemory(name=seg_name(oid))
+        except FileNotFoundError:
+            return None
+        if shm.buf.nbytes < seg["nbytes"]:
+            shm.close()
+            return None
+        k, v = _carve(shm.buf, seg, layout, dtype)
+        return AttachedSegment(k, v, shm=shm, store=self.store, oid=oid,
+                               delete=delete)

@@ -274,19 +274,23 @@ class LLMServer:
         # serving SLO histograms (TTFT / TPOT / occupancy / KV utilization),
         # tagged by engine flavor so paged and dense replicas in one process
         # keep separate series; stats()["slo"] summarizes via
-        # metrics.histogram_summary
+        # metrics.histogram_summary. TTFT/TPOT also carry a request-path
+        # tag: `local` for colocated prefill+decode, `pd` for requests
+        # whose prompt KV arrived from a prefill replica (pd.py observes
+        # those — the disaggregated path never passes through _admit)
         self._slo_tags = {"engine": ("paged" if self.page_mgr is not None
-                                     else "dense")}
+                                     else "dense"),
+                          "path": "local"}
         self._m_ttft = _metrics.get_or_create(
             _metrics.Histogram, "serve_ttft_s",
             "time to first token: admit → first emitted token (s)",
             boundaries=[0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10],
-            tag_keys=("engine",))
+            tag_keys=("engine", "path"))
         self._m_tpot = _metrics.get_or_create(
             _metrics.Histogram, "serve_tpot_ms",
             "per-token decode latency: host-sync wall time / tokens (ms)",
             boundaries=[0.5, 1, 2, 5, 10, 20, 50, 100, 200],
-            tag_keys=("engine",))
+            tag_keys=("engine", "path"))
         self._m_occupancy = _metrics.get_or_create(
             _metrics.Histogram, "serve_batch_occupancy",
             "active slots / batch capacity, sampled per decode sync",
@@ -548,14 +552,15 @@ class LLMServer:
             self._m_tokens.inc(tokens)
             self._m_tpot.observe(dt_s / tokens * 1e3, tags=self._slo_tags)
         self._m_chunk_ms.observe(dt_s * 1e3)
+        eng_tags = {"engine": self._slo_tags["engine"]}
         cap = len(self._active) + len(self._free)
         if cap:
             self._m_occupancy.observe(len(self._active) / cap,
-                                      tags=self._slo_tags)
+                                      tags=eng_tags)
         if self.page_mgr is not None and self.page_mgr.num_pages:
             self._m_kv_util.observe(
                 self.page_mgr.pages_in_use / self.page_mgr.num_pages,
-                tags=self._slo_tags)
+                tags=eng_tags)
         from ray_tpu.util import tracing
         if tracing.enabled():
             # one span per device round trip — the decode timeline shows

@@ -267,6 +267,26 @@ def transfer_bytes_total() -> int:
     return int(_counter_total("transfer_fetch_bytes"))
 
 
+def kv_ship_counters() -> Dict[str, float]:
+    """PD KV-shipment data-plane tallies (per process: the prefill replica
+    counts seals, the decode replica counts pulls). bytes/pages/segments
+    tally sealed shm segments; saved_pages counts pages NOT shipped
+    because the decode side already held them in its prefix cache (the
+    suffix-only delta); attach_hits / stream_pulls / rpc_pulls split the
+    decode pull path by transport (same-host zero-copy attach,
+    parallel_fetch ranged streams, raw-bytes RPC fallback)."""
+    return {"bytes": _counter_total("kv_ship_bytes"),
+            "pages": _counter_total("kv_ship_pages"),
+            "segments": _counter_total("kv_ship_segments"),
+            "requests": _counter_total("kv_ship_requests"),
+            "saved_pages": _counter_total("kv_ship_saved_pages"),
+            "attach_hits": _counter_total("kv_ship_attach_hits"),
+            "stream_pulls": _counter_total("kv_ship_stream_pulls"),
+            "rpc_pulls": _counter_total("kv_ship_rpc_pulls"),
+            "rpc_fallback_bytes": _counter_total(
+                "kv_ship_rpc_fallback_bytes")}
+
+
 def prefetch_counters() -> Dict[str, float]:
     """Dependency-prefetching dispatch tallies (per process — the head sees
     its own dispatches, each node agent its own). hits/misses are counted

@@ -51,7 +51,7 @@ __all__ = [
     "enabled", "sample_rate", "refresh", "new_trace_id", "new_span_id",
     "trace_id_for", "stamp", "record_span", "span", "set_current",
     "get_current", "current_trace_id", "events", "drain", "clear",
-    "to_chrome", "summary", "set_process_label",
+    "to_chrome", "summary", "set_process_label", "record_window",
 ]
 
 _lock = threading.Lock()
@@ -193,6 +193,21 @@ def record_span(name: str, cat: str, trace_id: Optional[str],
         except Exception:  # noqa: BLE001 - tracing must never raise
             pass
     buf.append((name, cat, trace_id, span_id, parent_id, ts, dur, tid, args))
+
+
+def record_window(name: str, cat: str, trace_id: Optional[str],
+                  t0: float, t1: float, tid: Any = 0,
+                  args: Optional[dict] = None,
+                  parent_id: Optional[int] = None) -> None:
+    """Record a span whose window was measured by the caller (epoch
+    seconds). For phases whose start and end straddle awaits or callbacks
+    where the ``span()`` context manager can't wrap the region — e.g. the
+    PD request decomposition stamps queue / prefill / kv_ship windows
+    from timestamps captured inside its pull loop."""
+    if not _enabled:
+        return
+    record_span(name, cat, trace_id, new_span_id(), parent_id, t0,
+                max(0.0, t1 - t0), tid=tid, args=args)
 
 
 @contextmanager

@@ -222,3 +222,47 @@ def test_prefix_pages_survive_concurrent_decode():
     # cached pages still clean after all the concurrent traffic
     out3 = _run(srv.generate(prompt, max_tokens=6))
     assert out3["tokens"] == out1["tokens"]
+
+
+def test_lru_eviction_spares_borrowed_prefix_pages():
+    """Under pool pressure the LRU evicts PARKED (refcount-0) cached pages
+    only; prefix pages a live slot borrowed are pinned — off the LRU —
+    and must survive the eviction intact (the PD decode path depends on
+    this: shipped-suffix installs scatter around borrowed leading pages)."""
+    from ray_tpu.ops.paged_attention import PageManager
+    mgr = PageManager(num_pages=11, page_size=4, batch_slots=3,
+                      max_pages_per_seq=8, prefix_cache=True)
+    a = list(range(9))             # 2 full pages registerable
+    b = list(range(50, 59))
+    for slot, p in ((0, a), (1, b)):
+        _, cached = mgr.allocate_prefix(slot, p, 9)
+        assert cached == 0
+        mgr.register_prefix(slot, p)
+        mgr.free(slot)
+    assert mgr.cached_pages == 4   # both prompts parked in the LRU
+
+    # borrow A's pages: pinned for slot 0, popped from the LRU
+    _, cached = mgr.allocate_prefix(0, a, 12)
+    assert cached == 8
+    assert mgr.shared_page_count(0) == 2
+    assert len(mgr.table_slice(0, 0, 3)) == 3  # PD extraction unit works
+    with pytest.raises(IndexError):
+        mgr.table_slice(0, 2, 5)   # past the allocation
+
+    # pressure: of the 10 usable pages (page 0 is the padding sentinel),
+    # slot 0 holds A's 2 borrowed + 1 fresh and B's 2 sit parked → 5
+    # free. A 7-page request must evict BOTH of B's parked pages; A's
+    # are borrowed, hence pinned and untouchable.
+    _, cached2 = mgr.allocate_prefix(1, list(range(100, 128)), 28)
+    assert cached2 == 0
+    assert mgr.cached_pages == 2   # A still cached, B gone
+    mgr.free(1)
+    mgr.free(0)
+
+    # A survived eviction and is reusable; B must miss
+    _, hit = mgr.allocate_prefix(0, a, 9)
+    assert hit == 8
+    mgr.free(0)
+    _, miss = mgr.allocate_prefix(1, b, 9)
+    assert miss == 0
+    mgr.free(1)

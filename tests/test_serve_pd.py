@@ -14,9 +14,11 @@ import pytest
 
 def _cfg(**kw):
     from ray_tpu.serve.llm import LLMConfig
-    return LLMConfig(preset="tiny", max_batch_slots=4, max_seq_len=128,
-                     paged=True, page_size=16, prefill_chunk=32,
-                     prefix_cache=False, seed=3, **kw)
+    base = dict(preset="tiny", max_batch_slots=4, max_seq_len=128,
+                paged=True, page_size=16, prefill_chunk=32,
+                prefix_cache=False, seed=3)
+    base.update(kw)
+    return LLMConfig(**base)
 
 
 @pytest.fixture(scope="module")
@@ -100,3 +102,138 @@ def test_pd_requires_paged():
         server = PrefillServer(LLMConfig(preset="tiny", paged=False,
                                          max_seq_len=64))
         asyncio.run(server.prefill_kv([1, 2, 3]))
+
+
+# ------------------- streaming data plane (zero-copy KV-page shipment) ---
+
+def _no_arrays(x, where=""):
+    """Control frames must carry metadata only — any ndarray in a header
+    or segment dict means KV bytes went back into the RPC plane."""
+    if isinstance(x, np.ndarray):
+        raise AssertionError(f"ndarray leaked into control frame at {where}")
+    if isinstance(x, dict):
+        for k, v in x.items():
+            _no_arrays(v, f"{where}.{k}")
+    elif isinstance(x, (list, tuple)):
+        for i, v in enumerate(x):
+            _no_arrays(v, f"{where}[{i}]")
+
+
+def test_stream_frames_carry_no_kv_bytes(servers):
+    _, prefill, _ = servers
+
+    async def drive():
+        header = await prefill.prefill_begin(list(range(2, 39)))
+        _no_arrays(header, "header")
+        have, done = 0, False
+        while not done:
+            res = await prefill.prefill_wait(header["ship_id"], have)
+            _no_arrays(res, "wait")
+            have += len(res["segments"])
+            done = res["done"]
+        assert have >= 1
+        await prefill.prefill_drop(header["ship_id"])
+        return header
+
+    header = asyncio.run(drive())
+    assert header["total_pages"] == 3 and header["prompt_len"] == 37
+    # slot released; drop freed every segment
+    assert prefill.stats()["active"] == 0
+
+
+def test_stream_suffix_install_parity():
+    """Prefix-cache on both sides: the second request sharing 2 leading
+    pages must ship only its suffix AND still decode bit-identically."""
+    from ray_tpu.serve.llm import LLMServer
+    from ray_tpu.serve.pd import PDServer, PrefillServer
+    from ray_tpu.util import metrics as _metrics
+
+    ref = LLMServer(_cfg(prefix_cache=True))
+    prefill = PrefillServer(_cfg(prefix_cache=True), params=ref.params)
+    pd = PDServer(_cfg(prefix_cache=True), params=ref.params,
+                  prefill=prefill)
+
+    p1 = list(range(5, 42))               # 37 tokens -> 3 pages
+    p2 = p1[:32] + [91, 92, 93, 94, 95]   # shares the first 2 pages
+
+    async def both(server):
+        a = await server.generate(p1, max_tokens=8)
+        b = await server.generate(p2, max_tokens=8)
+        return a["tokens"], b["tokens"]
+
+    before = _metrics.kv_ship_counters()
+    got = asyncio.run(both(pd))
+    want = asyncio.run(both(ref))
+    assert got == want
+    after = _metrics.kv_ship_counters()
+    # the shared prefix pages were never shipped for p2
+    assert after["saved_pages"] - before["saved_pages"] >= 2
+    assert after["pages"] - before["pages"] <= 4  # 3 (p1) + 1 suffix (p2)
+
+
+def test_stream_forced_remote_pull(servers, monkeypatch):
+    """RAY_TPU_KV_ATTACH=0 forbids the same-host shm attach, forcing the
+    KVDataServer + parallel_fetch ranged-transfer path."""
+    from ray_tpu.util import metrics as _metrics
+    plain, _, pd = servers
+    monkeypatch.setenv("RAY_TPU_KV_ATTACH", "0")
+    p = list(range(11, 53))
+    before = _metrics.kv_ship_counters()
+    got = asyncio.run(pd.generate(p, max_tokens=10))
+    ref = asyncio.run(plain.generate(p, max_tokens=10))
+    assert got["tokens"] == ref["tokens"]
+    after = _metrics.kv_ship_counters()
+    assert after["stream_pulls"] - before["stream_pulls"] >= 1
+    assert after["attach_hits"] == before["attach_hits"]
+
+
+def test_legacy_rpc_handoff_escape_hatch(servers, monkeypatch):
+    """RAY_TPU_KV_SHIP=0 restores the whole-KV-over-RPC hand-off."""
+    from ray_tpu.util import metrics as _metrics
+    plain, _, pd = servers
+    monkeypatch.setenv("RAY_TPU_KV_SHIP", "0")
+    p = [9, 8, 7] * 8
+    before = _metrics.kv_ship_counters()
+    got = asyncio.run(pd.generate(p, max_tokens=9))
+    ref = asyncio.run(plain.generate(p, max_tokens=9))
+    assert got["tokens"] == ref["tokens"]
+    # the streaming plane was bypassed entirely
+    assert _metrics.kv_ship_counters()["segments"] == before["segments"]
+
+
+def test_serving_bench_smoke_gate():
+    """Tier-1 hook for the serving bench's --smoke mode: a subprocess PD
+    round trip on CPU must ship KV through the streaming plane (counters
+    nonzero) with zero KV bytes in the RPC control frames."""
+    import json
+    import os
+    import subprocess
+    import sys
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    p = subprocess.run(
+        [sys.executable, os.path.join(root, "benchmarks",
+                                      "serving_bench.py"), "--smoke"],
+        capture_output=True, text=True, timeout=420, env=env)
+    assert p.returncode == 0, (p.stdout, p.stderr)
+    rec = json.loads(p.stdout.strip().splitlines()[-1])
+    assert rec["smoke"] == "ok"
+    assert rec["kv_ship"]["bytes"] > 0 and rec["kv_ship"]["pages"] > 0
+    assert rec["kv_ship"]["rpc_fallback_bytes"] == 0
+
+
+def test_pd_slo_histograms_tagged(servers):
+    """PD requests must land in the serving SLO histograms under path=pd
+    (the colocated path records path=local) — satellite of the streaming
+    rework: TTFT/TPOT were previously never observed for PD."""
+    from ray_tpu.util import metrics as _metrics
+    _, _, pd = servers
+    asyncio.run(pd.generate(list(range(40, 70)), max_tokens=8))
+
+    def series_tags(name):
+        m = _metrics._registry.get(name)
+        assert m is not None, f"{name} not registered"
+        return [dict(k) for k in m.snapshot()["count"]]
+
+    assert any(t.get("path") == "pd" for t in series_tags("serve_ttft_s"))
+    assert any(t.get("path") == "pd" for t in series_tags("serve_tpot_ms"))
