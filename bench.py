@@ -192,7 +192,8 @@ def _kill_stale_workers():
         except (ProcessLookupError, PermissionError):
             pass
     for pat in (r"bench\.py --measure",
-                r"benchmarks/(serving|rllib|decode|transfer|chain)_bench\.py"):
+                r"benchmarks/(serving|rllib|decode|transfer|chain|pipeline)"
+                r"_bench\.py"):
         for pid in _pgrep(pat):
             try:
                 _log(f"bench: killing stray bench child pid={pid} ({pat})")
@@ -634,7 +635,8 @@ def orchestrate():
                 ("rllib_ppo", "rllib_bench.py", 600, None),
                 ("core_cp", "core_bench.py", 300, None),
                 ("transfer_dp", "transfer_bench.py", 300, None),
-                ("chain_dp", "chain_bench.py", 300, None)):
+                ("chain_dp", "chain_bench.py", 300, None),
+                ("pipeline_pp", "pipeline_bench.py", 600, None)):
             result[key] = _run_aux_bench(script, tmo, extra)
             # re-emit the merged-so-far record (NOT a bare keyed line): the
             # last complete JSON line on stdout is always a full headline

@@ -99,11 +99,38 @@ def _cmd_topology(args):
     print(json.dumps(tpu.slice_topology(), indent=2))
 
 
+def _render_bubble(stats: dict) -> str:
+    """Bubble-fraction table from tracing.bubble_stats output: per-worker
+    gaps between exec-phase windows (pipeline bubbles, scheduling stalls).
+    Pure function of its input so tests render without a live cluster."""
+    lines = [f"== Bubble fractions (phase={stats['phase']}) ==",
+             f"  {'worker':<10} {'windows':>8} {'busy':>9} {'span':>9} "
+             f"{'bubble':>9} {'bubble%':>8}"]
+    rows = list(stats.get("workers", {}).items())
+    for tid, w in rows:
+        lines.append(
+            f"  {str(tid):<10} {w['windows']:>8} {w['busy_s']:>8.3f}s "
+            f"{w['span_s']:>8.3f}s {w['bubble_s']:>8.3f}s "
+            f"{w['bubble_fraction'] * 100:>7.1f}%")
+    o = stats.get("overall", {})
+    lines.append(
+        f"  {'overall':<10} {'-':>8} {o.get('busy_s', 0.0):>8.3f}s "
+        f"{o.get('span_s', 0.0):>8.3f}s {o.get('bubble_s', 0.0):>8.3f}s "
+        f"{o.get('bubble_fraction', 0.0) * 100:>7.1f}%")
+    if not rows:
+        lines.append("  (no exec-phase windows — is tracing on and did "
+                     "any task complete?)")
+    return "\n".join(lines)
+
+
 def _cmd_timeline(args):
     import ray_tpu
-    ray_tpu.init(ignore_reinit_error=True)
-    path = ray_tpu.timeline(args.output)
-    print(f"wrote {path}")
+    _connect(getattr(args, "address", None))
+    events = ray_tpu.timeline(args.output)
+    print(f"wrote {args.output} ({len(events)} events)")
+    if getattr(args, "bubble", False):
+        from ray_tpu.util.tracing import bubble_stats
+        print(_render_bubble(bubble_stats(events)))
     ray_tpu.shutdown()
 
 
@@ -203,6 +230,11 @@ def main(argv=None):
     sub.add_parser("topology", help="TPU slice topology")
     tl = sub.add_parser("timeline", help="export chrome trace")
     tl.add_argument("--output", default="timeline.json")
+    tl.add_argument("--address", default=None,
+                    help="controller socket path (default: RAY_TPU_ADDRESS)")
+    tl.add_argument("--bubble", action="store_true",
+                    help="print per-worker bubble fractions (gaps between "
+                         "exec-phase windows)")
 
     job = sub.add_parser("job", help="submit / inspect / stop jobs")
     jsub = job.add_subparsers(dest="job_cmd", required=True)

@@ -693,7 +693,7 @@ class WorkerClient(BaseClient):
         meta_len, size, inline, contained = self._encode_to_store(oid, value)
         return (oid, meta_len, size, inline, contained)
 
-    def send_task_done(self, task_id, results, error, span=None):
+    def send_task_done(self, task_id, results, error, span=None, spans=None):
         """Publish a task's completion. With prefetching dispatch on, the
         entry rides the ordered batch flusher (fire-and-forget: the exec
         thread is free for the next task without awaiting application, and
@@ -703,15 +703,18 @@ class WorkerClient(BaseClient):
 
         `span` is the worker-side timing tuple (resolve start, exec start,
         exec end — epoch seconds) the controller folds into the task's
-        phase spans; None when tracing is off/unsampled."""
+        phase spans; None when tracing is off/unsampled. `spans` is the
+        drained tracing ship-outbox (Chrome-format dicts): app windows
+        recorded in THIS worker during exec, bound for the head timeline."""
         if self._pipelined and _prefetch_enabled():
             # urgent: the flusher timer skips its coalescing nap — callers
             # may already be blocked in ray.get() on these results
-            self._flusher.append(("task_done", task_id, results, error, span),
-                                 urgent=True)
+            self._flusher.append(
+                ("task_done", task_id, results, error, span, spans),
+                urgent=True)
         else:
             self._send("task_done", task_id=task_id, results=results,
-                       error=error, span=span)
+                       error=error, span=span, spans=spans)
 
     def wait(self, oids, num_returns, timeout):
         tid = self.current_task_id

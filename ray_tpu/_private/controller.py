@@ -783,7 +783,10 @@ class Controller:
                 self._on_task_done(
                     w, {"task_id": e[1], "results": results, "error": e[3],
                         # older 4-tuple entries carry no worker span stamps
-                        "span": e[4] if len(e) > 4 else None})
+                        "span": e[4] if len(e) > 4 else None,
+                        # 6-tuple entries ship worker app spans (Chrome
+                        # dicts) bound for the head timeline
+                        "spans": e[5] if len(e) > 5 else None})
 
     def apply_batch_local(self, entries):
         """Driver-side batch: same entries, no per-worker tally (driver refs
@@ -1645,6 +1648,17 @@ class Controller:
             ("_task", rec.spec.name or task_id, w.pid or 1, rec.ts_start,
              rec.ts_end, rec.spec.trace_id, task_id))
         self._record_task_spans(rec, w.pid or 1, p.get("span"))
+        shipped = p.get("spans")
+        if shipped:
+            # worker app spans (tracing.ship_window — already Chrome dicts;
+            # format_timeline passes dicts through). On a worker node this
+            # controller's outbox forwards them to the head via heartbeat.
+            self.timeline_events.extend(shipped)
+            if getattr(self, "span_ship", False):
+                outbox = self.span_outbox
+                outbox.extend(shipped)
+                if len(outbox) > 20000:
+                    del outbox[:len(outbox) - 20000]
         spec = rec.spec
         actor = self.actors.get(spec.actor_id) if spec.actor_id else None
         if actor is not None and not spec.is_actor_creation:
@@ -1720,14 +1734,21 @@ class Controller:
         t_sub = rec.ts_submit or rec.ts_start
         t_start, t_end = rec.ts_start, rec.ts_end
         exec_end = t_end
+        exec_start = t_start
         if wspan:
             try:
                 exec_end = min(max(float(wspan[2]), t_start), t_end)
+                # dispatch -> worker exec start: frame transit + arg
+                # resolve/fetch on the worker — the per-task "xfer" phase
+                # (the inter-stage hop for pipeline-shaped workloads)
+                exec_start = min(max(float(wspan[1]), t_start), exec_end)
             except (TypeError, IndexError, ValueError):
-                exec_end = t_end
+                exec_end, exec_start = t_end, t_start
         phases = {"queued": max(t_start - t_sub, 0.0),
-                  "exec": max(exec_end - t_start, 0.0),
+                  "exec": max(exec_end - exec_start, 0.0),
                   "publish": max(t_end - exec_end, 0.0)}
+        if exec_start > t_start:
+            phases["xfer"] = exec_start - t_start
         pw = rec.prefetch_windows
         if pw:
             p0 = min(a for a, _ in pw)
@@ -1739,8 +1760,11 @@ class Controller:
         trace_id = rec.spec.trace_id
         if trace_id is None or not tracing.enabled():
             return
-        windows = [("queued", t_sub, t_start), ("exec", t_start, exec_end),
+        windows = [("queued", t_sub, t_start),
+                   ("exec", exec_start, exec_end),
                    ("publish", exec_end, t_end)]
+        if exec_start > t_start:
+            windows.insert(1, ("xfer", t_start, exec_start))
         if pw:
             windows.insert(1, ("prefetch", p0, p1))
         entry = ("_phases", rec.spec.name or rec.spec.task_id, tid,

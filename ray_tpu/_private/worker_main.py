@@ -222,9 +222,13 @@ def _execute(ws, p):
                             tracing.new_span_id(), spec.parent_span_id,
                             t_exec0, t_done - t_exec0,
                             args={"task_id": spec.task_id})
+    # app spans queued via tracing.ship_window during exec (e.g. the MPMD
+    # pipeline stages' fwd/bwd windows) piggyback on this completion frame
+    # — the worker ring itself is never drained by any heartbeat
+    shipped = tracing.take_shipped() or None
     # fire-and-forget: rides the ordered batch flusher behind this task's
     # puts (legacy direct frame when prefetching dispatch is off)
-    ws.client.send_task_done(spec.task_id, results, error, span)
+    ws.client.send_task_done(spec.task_id, results, error, span, shipped)
 
 
 def _drain_generator(ws, spec, handle_oid, gen):

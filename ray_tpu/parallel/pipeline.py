@@ -65,21 +65,35 @@ def pipeline_apply(
         outs = jnp.where(is_last, outs, jnp.zeros_like(outs))
         return jax.lax.psum(outs, axis)
 
-    shard_map = jax.shard_map
+    # jax moved shard_map out of experimental in 0.5.x and renamed the
+    # check_rep knob to check_vma; support both so the SPMD reference runs
+    # on the baked-in 0.4.x toolchain too
+    shard_map = getattr(jax, "shard_map", None)
+    check_kw = {"check_vma": False}
+    if shard_map is None:
+        from jax.experimental.shard_map import shard_map
+        check_kw = {"check_rep": False}
 
     return shard_map(
         per_device, mesh=mesh,
         in_specs=(P(axis), P()),  # stages sharded; microbatches replicated
         out_specs=P(),
-        check_vma=False,
+        **check_kw,
     )(stage_params, microbatches)
 
 
 def make_microbatches(batch: jax.Array, num_microbatches: int) -> jax.Array:
     """[B, ...] → [M, B/M, ...]."""
+    if num_microbatches < 1:
+        raise ValueError(f"num_microbatches must be >= 1, got "
+                         f"{num_microbatches}")
     B = batch.shape[0]
     if B % num_microbatches:
-        raise ValueError(f"batch {B} not divisible by M={num_microbatches}")
+        raise ValueError(
+            f"batch size {B} (batch shape {tuple(batch.shape)}) is not "
+            f"divisible by num_microbatches={num_microbatches}: "
+            f"{B} % {num_microbatches} == {B % num_microbatches} rows "
+            f"would be dropped — pad or resize the batch")
     return batch.reshape((num_microbatches, B // num_microbatches)
                          + batch.shape[1:])
 

@@ -10,6 +10,7 @@ from .config import (TRAIN_DATASET_KEY, BackendConfig, CheckpointConfig,
                      DataConfig, FailureConfig, RunConfig, ScalingConfig,
                      SyncConfig, TrainingFailedError)
 from .ingest import iter_device_batches, prefetch_iterator
+from .mpmd import MPMDPipeline, PipelineStage, build_pipeline, sgd
 from .session import (TrainContext, TrainingStopped, get_checkpoint,
                       get_context, get_dataset_shard, report)
 from .trainer import JaxTrainer, Result
@@ -21,4 +22,5 @@ __all__ = [
     "ScalingConfig", "JaxTrainer", "Result", "TrainContext",
     "TrainingStopped", "report", "get_checkpoint", "get_context",
     "get_dataset_shard", "iter_device_batches", "prefetch_iterator",
+    "MPMDPipeline", "PipelineStage", "build_pipeline", "sgd",
 ]

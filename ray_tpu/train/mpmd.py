@@ -1,0 +1,410 @@
+"""MPMD pipeline parallelism over the actor fabric (ROADMAP item 3;
+contrast: `parallel/pipeline.py` runs the same schedule as ONE compiled
+SPMD program over a mesh `pp` axis).
+
+Each pipeline stage is a long-lived `PipelineStage` actor owning its own
+jitted forward/backward program and optimizer shard — a separate program
+on (ideally) a separate host, per the MPMD argument of arXiv:2412.14374.
+Activations and gradients move between stages as object-store refs
+through the existing data plane: submission is fire-and-forget
+(`.remote()` chains form the schedule), per-actor FIFO execution makes
+the per-stage op order exactly the submission order, and the
+dependency-prefetching dispatch (PR 8) overlaps each inter-stage hop
+with the consuming stage's current compute.
+
+Schedule: 1F1B (PipeDream-flush). Stage i runs ``min(S-1-i, M)`` warmup
+forwards, then alternates one-forward/one-backward to the steady state,
+then drains the remaining backwards. Per-stage live state is bounded:
+the input stash holds at most warmup+1 microbatches, and the driver
+releases every activation/grad ref immediately after submitting its
+consumer — the controller's task-arg pin keeps the object alive exactly
+until the consumer finishes, so ~S microbatch-sized objects are in
+flight regardless of M (asserted by tests via the PR 11 LeakDetector).
+
+Backward recomputes the stage's forward under ``jax.vjp`` (per-stage
+activation rematerialization): the stash keeps only each microbatch's
+INPUT, not the residuals, trading one extra forward for O(1) stash
+entries of microbatch size.
+
+Tracing: every stage ships ``pipeline.fwd`` / ``pipeline.bwd`` windows
+(stage + microbatch tagged) to the head timeline by piggybacking on its
+task_done frames (``tracing.ship_window``), and the controller derives a
+per-task ``xfer`` phase (dispatch→exec-start: frame transit + arg
+resolve/fetch on the worker) — bubble fraction falls out of the gaps
+between exec windows (``tracing.bubble_stats``).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+__all__ = ["PipelineStage", "MPMDPipeline", "build_pipeline", "sgd"]
+
+
+class _SGD:
+    """Minimal optax-protocol optimizer (init/update) so the default
+    training path needs no external dependency; any optax
+    GradientTransformation drops in unchanged."""
+
+    def __init__(self, lr: float):
+        self.lr = lr
+
+    def init(self, params):
+        return ()
+
+    def update(self, grads, state, params=None):
+        import jax
+        lr = self.lr
+        return jax.tree_util.tree_map(lambda g: -lr * g, grads), state
+
+
+def sgd(lr: float = 0.1) -> _SGD:
+    return _SGD(lr)
+
+
+class PipelineStage:
+    """One pipeline stage: jitted fwd/bwd programs + optimizer shard.
+
+    Runs as an actor (wrapped by ``build_pipeline``); plain-class methods
+    so it is also directly testable in-process.
+
+    stage_fn: (params, x) -> y          (inter-stage activation contract)
+    loss_fn:  (y, target) -> scalar     (last stage only, training)
+    optimizer: optax-protocol object (init/update); required for
+      ``apply_grads``.
+    """
+
+    def __init__(self, stage_index: int, num_stages: int,
+                 stage_fn: Callable, params,
+                 loss_fn: Optional[Callable] = None, optimizer=None):
+        import jax
+        import jax.numpy as jnp
+        self._jax = jax
+        self.index = stage_index
+        self.num_stages = num_stages
+        self.is_first = stage_index == 0
+        self.is_last = stage_index == num_stages - 1
+        self.stage_fn = stage_fn
+        self.loss_fn = loss_fn
+        self.params = jax.device_put(params)
+        self.optimizer = optimizer
+        self.opt_state = optimizer.init(self.params) if optimizer else None
+        self._stash: Dict[Any, tuple] = {}
+        self._grad = None
+        self._steps = 0
+        self._peak_stash = 0
+        self._fwd = jax.jit(stage_fn)
+        if self.is_last and loss_fn is not None:
+            def _loss(p, x, t):
+                return loss_fn(stage_fn(p, x), t)
+            self._loss = jax.jit(_loss)
+            self._bwd_last = jax.jit(jax.grad(_loss, argnums=(0, 1)))
+
+        def _vjp(p, x, g):
+            _, vjp_fn = jax.vjp(stage_fn, p, x)
+            return vjp_fn(g)
+
+        self._bwd = jax.jit(_vjp)
+        self._acc = jax.jit(
+            lambda a, b: jax.tree_util.tree_map(jnp.add, a, b))
+        self._apply = jax.jit(
+            lambda p, u: jax.tree_util.tree_map(jnp.add, p, u))
+
+    # ---------------------------------------------------------------- trace
+    def _ship(self, name: str, t0: float, mb) -> None:
+        from ray_tpu.util import tracing
+        tracing.ship_window(
+            name, "pipeline", tracing.current_trace_id(), t0, time.time(),
+            tid=os.getpid(), args={"stage": self.index, "mb": mb})
+
+    # ----------------------------------------------------------------- ops
+    def forward(self, mb, x, target=None, stash: bool = True, after=None):
+        """Run this stage's forward for microbatch ``mb``.
+
+        Returns the activation (or the scalar loss at a loss-owning last
+        stage). ``stash=True`` keeps the INPUT for the matching
+        ``backward`` (remat); forward-only runs pass stash=False so
+        nothing accumulates. ``after`` is an ignored sequencing token:
+        the runner passes the previous same-stage op's output ref so
+        dep-readiness (which decides actor-queue order) serializes this
+        stage's ops in exact 1F1B order.
+        """
+        t0 = time.time()
+        if self.is_last and self.loss_fn is not None and target is not None:
+            out = self._loss(self.params, x, target)
+        else:
+            out = self._fwd(self.params, x)
+        self._jax.block_until_ready(out)
+        if stash:
+            self._stash[mb] = (x, target)
+            self._peak_stash = max(self._peak_stash, len(self._stash))
+        self._ship("pipeline.fwd", t0, mb)
+        return out
+
+    def backward(self, mb, grad=None, after=None):
+        """Backward for microbatch ``mb``: recompute forward under vjp,
+        accumulate the param-grad shard, return the input grad (shipped
+        upstream; None at stage 0 — nothing consumes it). ``after`` is
+        the runner's sequencing token (see ``forward``)."""
+        t0 = time.time()
+        x, target = self._stash.pop(mb)
+        if self.is_last and self.loss_fn is not None:
+            dp, dx = self._bwd_last(self.params, x, target)
+        else:
+            dp, dx = self._bwd(self.params, x, grad)
+        self._grad = dp if self._grad is None else self._acc(self._grad, dp)
+        self._jax.block_until_ready(dx)
+        self._ship("pipeline.bwd", t0, mb)
+        return None if self.is_first else dx
+
+    def apply_grads(self, num_microbatches: int, after=None) -> dict:
+        """Flush-phase optimizer step on the accumulated grad (mean over
+        microbatches); zeroes the accumulator. ``after`` (the stage's
+        last backward ref) gates dispatch behind the full drain."""
+        if self._grad is None:
+            raise RuntimeError(f"stage {self.index}: no accumulated grads")
+        jax = self._jax
+        g = jax.tree_util.tree_map(
+            lambda a: a / num_microbatches, self._grad)
+        updates, self.opt_state = self.optimizer.update(
+            g, self.opt_state, self.params)
+        self.params = self._apply(self.params, updates)
+        jax.block_until_ready(self.params)
+        self._grad = None
+        self._steps += 1
+        return {"stage": self.index, "step": self._steps,
+                "stash_depth": len(self._stash)}
+
+    # ------------------------------------------------------------- plumbing
+    def ping(self) -> int:
+        return self.index
+
+    def warmup(self, x, target=None):
+        """Trigger fwd/bwd compiles outside the measured window."""
+        self.forward("_warm", x, target)
+        g = None if (self.is_last and self.loss_fn is not None) else \
+            self._jax.numpy.zeros_like(self._fwd(self.params, x))
+        self.backward("_warm", g)
+        self._grad = None
+        self._peak_stash = 0
+        return True
+
+    def reset(self) -> int:
+        """Drop stashed inputs/grads (forward-only runs, test cleanup)."""
+        n = len(self._stash)
+        self._stash.clear()
+        self._grad = None
+        return n
+
+    def get_params(self):
+        return self.params
+
+    def stats(self) -> dict:
+        return {"stage": self.index, "steps": self._steps,
+                "stash_depth": len(self._stash),
+                "peak_stash": self._peak_stash}
+
+
+def _one_f_one_b_plan(stage_index: int, num_stages: int,
+                      num_microbatches: int) -> List[tuple]:
+    """Stage-local 1F1B op order: warmup forwards, steady 1F1B, cooldown
+    backwards. The last stage has zero warmup (F0 B0 F1 B1 ...)."""
+    S, M, i = num_stages, num_microbatches, stage_index
+    w = min(S - 1 - i, M)
+    ops = [("F", m) for m in range(w)]
+    for k in range(M - w):
+        ops.append(("F", w + k))
+        ops.append(("B", k))
+    ops.extend(("B", m) for m in range(M - w, M))
+    return ops
+
+
+class MPMDPipeline:
+    """Driver-side runner over S `PipelineStage` actors.
+
+    Build with ``build_pipeline``. ``train_step`` runs one 1F1B
+    step; ``run_forward`` is the inference/parity path (same math as
+    SPMD ``pipeline_apply``)."""
+
+    def __init__(self, stages: Sequence, num_microbatches: Optional[int],
+                 node_ids: Sequence[Optional[str]]):
+        import ray_tpu
+        self._ray = ray_tpu
+        self.stages = list(stages)
+        self.num_stages = len(self.stages)
+        self.num_microbatches = num_microbatches
+        self.node_ids = list(node_ids)
+        self.last_stats: Dict[str, Any] = {}
+
+    # ------------------------------------------------------------- forward
+    def run_forward(self, microbatches) -> list:
+        """Chain every microbatch through all stages (GPipe forward
+        order); returns last-stage outputs. Intermediate refs are
+        released as soon as their consumer is submitted."""
+        ray = self._ray
+        outs = []
+        for m, x in enumerate(microbatches):
+            ref = ray.put(x)
+            for h in self.stages:
+                nxt = h.forward.remote(m, ref, stash=False)
+                del ref  # consumer pin keeps it alive until used
+                ref = nxt
+            outs.append(ref)
+        vals = ray.get(outs)
+        del outs
+        return vals
+
+    # ---------------------------------------------------------------- train
+    def train_step(self, microbatches, targets) -> dict:
+        """One 1F1B training step over M microbatches.
+
+        Submission: repeatedly scan the stages round-robin, submitting
+        each stage's next planned op once its input ref exists (the
+        activation for a forward, the upstream grad for a backward).
+        Execution order per actor is dep-READINESS order, not submission
+        order — a dep-free task would jump a dep-waiting one — so every
+        op also carries the previous same-stage op's output ref as an
+        ``after`` token: readiness itself then serializes each stage in
+        exactly the 1F1B order, deadlock-free by construction, and
+        ``apply_grads`` (gated on the last backward's ref) cannot
+        overtake the drain. Activation and grad refs are dropped the
+        moment their consumer is submitted, bounding live microbatch
+        objects to ~S.
+        """
+        ray = self._ray
+        S = self.num_stages
+        M = len(microbatches)
+        if targets is None:
+            raise ValueError("train_step needs targets (and the pipeline a "
+                             "loss_fn); use run_forward for inference")
+        if len(targets) != M:
+            raise ValueError(
+                f"got {M} microbatches but {len(targets)} targets")
+        plans = [deque(_one_f_one_b_plan(i, S, M)) for i in range(S)]
+        acts: Dict[tuple, Any] = {}    # (stage, mb) -> activation-out ref
+        grads: Dict[tuple, Any] = {}   # (stage, mb) -> input-grad ref
+        tokens: List[Any] = [None] * S  # last submitted op's ref per stage
+        losses: List[Any] = []
+        peak_live = 0
+        submitted = 0
+        while any(plans):
+            progressed = False
+            for i, plan in enumerate(plans):
+                if not plan:
+                    continue
+                h = self.stages[i]
+                kind, m = plan[0]
+                if kind == "F":
+                    if i == 0:
+                        src = ray.put(microbatches[m])
+                    else:
+                        src = acts.pop((i - 1, m), None)
+                        if src is None:
+                            continue  # producer not submitted yet
+                    if i == S - 1:
+                        tref = ray.put(targets[m])
+                        ref = h.forward.remote(m, src, tref,
+                                               after=tokens[i])
+                        del tref
+                    else:
+                        ref = h.forward.remote(m, src, after=tokens[i])
+                    del src  # the submitted task's pin owns it now
+                    if i == S - 1:
+                        losses.append(ref)
+                    else:
+                        acts[(i, m)] = ref
+                else:  # "B"
+                    if i == S - 1:
+                        ref = h.backward.remote(m, after=tokens[i])
+                    else:
+                        g = grads.pop((i + 1, m), None)
+                        if g is None:
+                            continue
+                        ref = h.backward.remote(m, g, after=tokens[i])
+                        del g
+                    if i != 0:  # dx at stage 0 is None; only the token holds it
+                        grads[(i, m)] = ref
+                tokens[i] = ref
+                del ref
+                plan.popleft()
+                submitted += 1
+                progressed = True
+                peak_live = max(peak_live, len(acts) + len(grads))
+            if not progressed:
+                raise RuntimeError(
+                    "1F1B schedule deadlock (bug): "
+                    + repr([list(p)[:3] for p in plans]))
+        # each apply_grads is gated on its stage's last backward via the
+        # token; the get is the step barrier.
+        apply_refs = [h.apply_grads.remote(M, after=tokens[i])
+                      for i, h in enumerate(self.stages)]
+        del tokens[:]
+        stage_stats = ray.get(apply_refs)
+        del apply_refs
+        loss_vals = ray.get(losses)
+        del losses
+        mean_loss = float(sum(float(v) for v in loss_vals) / max(M, 1))
+        self.last_stats = {
+            "peak_live_refs": peak_live, "ops_submitted": submitted,
+            "stages": stage_stats,
+            "warmup_depths": [min(S - 1 - i, M) for i in range(S)]}
+        return {"loss": mean_loss,
+                "per_microbatch_loss": [float(v) for v in loss_vals],
+                "stats": self.last_stats}
+
+    # ------------------------------------------------------------- plumbing
+    def stage_stats(self) -> list:
+        return self._ray.get([h.stats.remote() for h in self.stages])
+
+    def get_params(self) -> list:
+        return self._ray.get([h.get_params.remote() for h in self.stages])
+
+    def shutdown(self) -> None:
+        """Release the actor handles; actor GC tears the stages down."""
+        stages, self.stages = self.stages, []
+        del stages
+
+
+def build_pipeline(stage_fns: Sequence[Callable], stage_params: Sequence,
+                   *, loss_fn: Optional[Callable] = None, optimizer=None,
+                   node_ids: Optional[Sequence[str]] = None,
+                   actor_options: Optional[dict] = None) -> MPMDPipeline:
+    """Create one `PipelineStage` actor per stage and wire the runner.
+
+    Placement: stage i gets ``NodeAffinitySchedulingStrategy(node_ids[i],
+    soft=True)``; when ``node_ids`` is omitted, stages round-robin over
+    the alive nodes so a 2-node cluster hosts alternating stages (the
+    MPMD shape: separate programs on separate hosts).
+    """
+    import ray_tpu
+    from ray_tpu.util.scheduling_strategies import (
+        NodeAffinitySchedulingStrategy)
+    S = len(stage_fns)
+    if len(stage_params) != S:
+        raise ValueError(
+            f"{S} stage_fns but {len(stage_params)} stage_params")
+    if node_ids is None:
+        rows = [n for n in ray_tpu.nodes() if n.get("alive", True)]
+        node_ids = [rows[i % len(rows)]["node_id"] for i in range(S)] \
+            if rows else [None] * S
+    elif len(node_ids) != S:
+        raise ValueError(f"{S} stages but {len(node_ids)} node_ids")
+    if optimizer is None and loss_fn is not None:
+        optimizer = sgd()
+    cls = ray_tpu.remote(PipelineStage)
+    stages = []
+    for i in range(S):
+        opts = dict(actor_options or {})
+        if node_ids[i] is not None:
+            opts["scheduling_strategy"] = NodeAffinitySchedulingStrategy(
+                node_id=node_ids[i], soft=True)
+        handle = cls.options(**opts).remote(
+            i, S, stage_fns[i], stage_params[i],
+            loss_fn=loss_fn if i == S - 1 else None,
+            optimizer=optimizer)
+        stages.append(handle)
+    ray_tpu.get([h.ping.remote() for h in stages])
+    return MPMDPipeline(stages, None, node_ids)

@@ -52,6 +52,7 @@ __all__ = [
     "trace_id_for", "stamp", "record_span", "span", "set_current",
     "get_current", "current_trace_id", "events", "drain", "clear",
     "to_chrome", "summary", "set_process_label", "record_window",
+    "ship_window", "take_shipped", "bubble_stats",
 ]
 
 _lock = threading.Lock()
@@ -68,6 +69,15 @@ _process_label: str = ""
 # per-thread current span context: (trace_id, span_id) — set by the worker
 # around task execution so nested submits and log records inherit it
 _ctx = threading.local()
+
+# spans explicitly marked for shipment to the head timeline: a worker's
+# ring is local-only (never drained by any heartbeat), so app code that
+# wants its windows on the cluster timeline queues them here and the
+# worker's next task_done frame carries them (zero extra round trips).
+# Chrome-format dicts (ts/dur in µs) — the controller's timeline ring
+# passes dict entries through unchanged.
+_ship_outbox: List[Dict[str, Any]] = []
+_SHIP_CAP = 4096
 
 
 def refresh() -> None:
@@ -210,6 +220,45 @@ def record_window(name: str, cat: str, trace_id: Optional[str],
                 max(0.0, t1 - t0), tid=tid, args=args)
 
 
+def ship_window(name: str, cat: str, trace_id: Optional[str],
+                t0: float, t1: float, tid: Any = 0,
+                args: Optional[dict] = None) -> None:
+    """``record_window`` + queue the span for shipment to the head
+    timeline. In a worker process the span rides the next task_done
+    frame; in the driver/head process the outbox is never drained but
+    the local ring (merged by DriverClient.timeline) already makes the
+    span visible — the outbox is bounded, so an undrained one is
+    harmless."""
+    if not _enabled:
+        return
+    record_window(name, cat, trace_id, t0, t1, tid=tid, args=args)
+    ev: Dict[str, Any] = {"name": name, "cat": cat, "ph": "X",
+                          "pid": os.getpid(), "tid": tid, "ts": t0 * 1e6,
+                          "dur": max(t1 - t0, 1e-6) * 1e6}
+    ar = dict(args or {})
+    if trace_id is not None:
+        ar["trace_id"] = trace_id
+    if ar:
+        ev["args"] = ar
+    global _dropped
+    with _lock:
+        if len(_ship_outbox) < _SHIP_CAP:
+            _ship_outbox.append(ev)
+        else:
+            _dropped += 1
+
+
+def take_shipped() -> List[Dict[str, Any]]:
+    """Drain the ship outbox (worker task_done path): each queued span
+    is forwarded exactly once."""
+    with _lock:
+        if not _ship_outbox:
+            return []
+        out = _ship_outbox[:]
+        del _ship_outbox[:]
+    return out
+
+
 @contextmanager
 def span(name: str, cat: str = "app", trace_id: Optional[str] = None,
          parent_id: Optional[int] = None, tid: Any = 0,
@@ -269,6 +318,7 @@ def clear() -> None:
     global _dropped
     with _lock:
         _buf.clear()
+        del _ship_outbox[:]
         _dropped = 0
     if hasattr(_ctx, "trace"):
         _ctx.trace = (None, None)
@@ -294,6 +344,76 @@ def to_chrome(evts: List[Dict[str, Any]]) -> List[Dict[str, Any]]:
         out.append({"name": "process_name", "ph": "M", "pid": os.getpid(),
                     "tid": 0, "args": {"name": _process_label}})
     return out
+
+
+def _merge_windows(wins: List[Tuple[float, float]]) -> List[Tuple[float, float]]:
+    """Sort + coalesce overlapping [t0, t1) intervals."""
+    out: List[Tuple[float, float]] = []
+    for a, b in sorted(wins):
+        if out and a <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], b))
+        else:
+            out.append((a, b))
+    return out
+
+
+def bubble_stats(events: List[Dict[str, Any]], phase: str = "exec",
+                 name_prefix: str = "",
+                 extra_cats: Tuple[str, ...] = ()) -> Dict[str, Any]:
+    """Per-worker bubble fractions from a Chrome-trace event list (the
+    output of ``api.timeline()`` — ts/dur in µs).
+
+    Groups ``task_phase`` windows whose ``args.phase`` matches (default:
+    the exec phase the controller stamps per task) by ``tid`` — the
+    worker pid — and measures, per worker, the idle gap between its
+    first window start and last window end:
+
+        bubble_fraction = 1 - busy / span
+
+    ``name_prefix`` filters to task names starting with it (phase events
+    are named ``fn:phase``); ``extra_cats`` additionally admits whole
+    events of those categories (e.g. "pipeline" for the stage-shipped
+    fwd/bwd windows). This is the single implementation behind both
+    ``python -m ray_tpu timeline --bubble`` and pipeline_bench's bound
+    comparison — 1F1B's steady state should sit near the GPipe bound
+    (S-1)/(M+S-1).
+    """
+    per_tid: Dict[Any, List[Tuple[float, float]]] = {}
+    for e in events:
+        if e.get("ph") not in (None, "X") or "ts" not in e:
+            continue
+        cat = e.get("cat")
+        if cat == "task_phase":
+            a = e.get("args") or {}
+            if a.get("phase") != phase:
+                continue
+            if name_prefix and not str(e.get("name", "")).startswith(
+                    name_prefix):
+                continue
+        elif cat not in extra_cats:
+            continue
+        t0 = e["ts"] / 1e6
+        per_tid.setdefault(e.get("tid", 0), []).append(
+            (t0, t0 + e.get("dur", 0.0) / 1e6))
+    workers = {}
+    total_busy = total_span = 0.0
+    for tid, wins in sorted(per_tid.items(), key=lambda kv: str(kv[0])):
+        merged = _merge_windows(wins)
+        busy = sum(b - a for a, b in merged)
+        span = merged[-1][1] - merged[0][0]
+        bubble = max(span - busy, 0.0)
+        workers[tid] = {
+            "windows": len(wins), "busy_s": busy, "span_s": span,
+            "bubble_s": bubble,
+            "bubble_fraction": bubble / span if span > 0 else 0.0}
+    total_busy = sum(w["busy_s"] for w in workers.values())
+    total_span = sum(w["span_s"] for w in workers.values())
+    return {"phase": phase, "workers": workers,
+            "overall": {
+                "busy_s": total_busy, "span_s": total_span,
+                "bubble_s": max(total_span - total_busy, 0.0),
+                "bubble_fraction": (1.0 - total_busy / total_span)
+                                   if total_span > 0 else 0.0}}
 
 
 def summary() -> Dict[str, Any]:

@@ -6,7 +6,13 @@ import jax
 import jax.numpy as jnp
 import pytest
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+# jax moved shard_map out of experimental in 0.5.x; support the 0.4.x
+# toolchain baked into this image too
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
 
 from ray_tpu.ops.attention import apply_rope, decode_attention, mha_reference
 from ray_tpu.ops.flash_attention import flash_attention

@@ -284,3 +284,56 @@ def test_pipeline_single_microbatch():
         xs, mesh)
     import numpy as np
     np.testing.assert_allclose(np.asarray(out), np.full((1, 4), 11.0))
+
+
+def test_pipeline_fewer_microbatches_than_stages():
+    # M < S: the schedule still runs M+S-1 ticks with the mb index clamped;
+    # outputs must match the sequential reference for every microbatch
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ray_tpu.parallel.mesh import make_mesh
+    from ray_tpu.parallel.pipeline import (make_microbatches, pipeline_apply,
+                                           shard_pipeline_params,
+                                           stack_stage_params)
+
+    S, M, d = 4, 2, 8
+    mesh = make_mesh({"pp": S}, devices=jax.devices()[:S])
+    key = jax.random.PRNGKey(3)
+    stage_params = [
+        {"w": jax.random.normal(jax.random.fold_in(key, i), (d, d)) / d}
+        for i in range(S)]
+
+    def stage_fn(p, x):
+        return jnp.tanh(x @ p["w"])
+
+    batch = jax.random.normal(key, (M * 2, d))
+    mbs = make_microbatches(batch, M)
+    out = pipeline_apply(
+        stage_fn,
+        shard_pipeline_params(stack_stage_params(stage_params), mesh),
+        mbs, mesh)
+    ref = batch
+    for p in stage_params:
+        ref = stage_fn(p, ref)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(ref.reshape(M, 2, d)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_make_microbatches_remainder_error():
+    import jax.numpy as jnp
+    import pytest
+    from ray_tpu.parallel.pipeline import make_microbatches
+
+    batch = jnp.zeros((10, 4))
+    with pytest.raises(ValueError) as ei:
+        make_microbatches(batch, 4)
+    # the message must carry the offending shapes, not just "bad input"
+    msg = str(ei.value)
+    assert "10" in msg and "(10, 4)" in msg and "num_microbatches=4" in msg
+    with pytest.raises(ValueError, match=">= 1"):
+        make_microbatches(batch, 0)
+    # exact division still works, including the M == B edge
+    assert make_microbatches(batch, 10).shape == (10, 1, 4)
+    assert make_microbatches(batch, 2).shape == (2, 5, 4)
