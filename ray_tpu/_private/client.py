@@ -27,6 +27,7 @@ import time
 import asyncio
 
 from .. import exceptions as exc
+from .._native import codec as _codec
 from ..util import tracing
 from . import ids, protocol, serialization
 from .object_store import StoreClient
@@ -74,11 +75,15 @@ def _ref_trace(oid: str):
     return None
 
 # flush when a batch accumulates this many entries / inline-put bytes, or
-# when the short timer fires — whichever comes first
+# when the short timer fires — whichever comes first. The nap is sized so a
+# realistic driver burst (a few hundred ~10 µs submits) completes before the
+# controller loop starts crunching the batch: on a small host they share
+# cores, and a nap that expires mid-burst preempts the submit loop. Blocking
+# consumers force-flush, so only pure fire-and-forget sees the nap at all.
 _FLUSH_MAX_ENTRIES = int(os.environ.get("RAY_TPU_FLUSH_MAX_ENTRIES", "128"))
 _FLUSH_MAX_BYTES = int(os.environ.get("RAY_TPU_FLUSH_MAX_BYTES",
                                       str(256 * 1024)))
-_FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_FLUSH_INTERVAL_S", "0.005"))
+_FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_FLUSH_INTERVAL_S", "0.008"))
 
 
 def _sync_submit_requested() -> bool:
@@ -182,7 +187,10 @@ class _DeltaFlusher:
                     if not self._in_sink:
                         self.flush_locked()
                     return
-        self._wake.set()
+        # already-set is the steady state in a burst: is_set() is a plain
+        # attribute read, set() takes the event's condition lock every call
+        if not self._wake.is_set():
+            self._wake.set()
 
     def drain_locked(self):
         """Take the pending entries without sinking them (the caller ships
@@ -285,10 +293,14 @@ class DriverClient(BaseClient):
     def _post_batch(self, entries):
         """Flusher sink: apply a drained batch on the controller loop. Loop
         callbacks run in post order, so posting under the flusher lock keeps
-        batches ordered among themselves and ahead of any later bridge call."""
+        batches ordered among themselves and ahead of any later bridge call.
+        Consecutive incref/decref runs collapse into packed refdelta blobs
+        first — the controller applies those through the sharded directory
+        in ONE bulk call instead of a dict hit per id."""
         try:
             self.loop.call_soon_threadsafe(
-                self.controller.apply_batch_local, entries)
+                self.controller.apply_batch_local,
+                _codec.fold_refdeltas(entries))
         except RuntimeError:
             pass  # loop already closed at shutdown
 
@@ -333,21 +345,15 @@ class DriverClient(BaseClient):
              else max(spec.num_returns, 1))
         oids = [ids.object_id_for_return(spec.task_id, i) for i in range(n)]
         _note_ref_trace(oids[0], inherited)
-        ctl = self.controller
-        with self._flusher.lock:
-            # fuse pending deltas with the submit into ONE loop callback:
-            # put registrations for the spec's args apply first, atomically
-            entries = self._flusher.drain_locked()
-
-            def run():
-                if entries:
-                    ctl.apply_batch_local(entries)
-                ctl.submit_pipelined(spec, oids)
-
-            try:
-                self.loop.call_soon_threadsafe(run)
-            except RuntimeError:
-                pass  # loop closed at shutdown: the refs are already dead
+        # the submit itself is a batch entry: a tight submit loop posts ONE
+        # loop callback per drained batch instead of one call_soon_threadsafe
+        # (and one loop self-pipe write) per task. Append order keeps the
+        # spec behind the put registrations of its own arguments. The append
+        # is deliberately NOT urgent: waking the flusher per submit turned a
+        # tight submit loop into a 3-thread GIL ping-pong. Every blocking
+        # consumer (get/wait/_call) force-flushes first, so the only cost of
+        # lazy dispatch is ≤ one coalescing nap on pure fire-and-forget.
+        self._flusher.append(("submit", spec, oids))
         return oids
 
     def get(self, oids, timeout=None):
@@ -521,17 +527,25 @@ class WorkerClient(BaseClient):
         self.task_available = threading.Condition()
         self._current = threading.local()  # per-exec-thread task id
         self.task_threads = {}  # task_id -> thread ident (for targeted cancel)
+        # codec negotiation: announce what we can decode; send with
+        # min(ours, controller's ceiling). Spawned workers read the ceiling
+        # from the env the controller set; attached drivers learn it from
+        # the hello reply (receivers sniff, so a stale 0 just means pickle).
+        own_ver = _codec.wire_version()
+        self._codec_ver = min(own_ver, int(
+            _os.environ.get("RAY_TPU_CODEC_VER", "0") or 0))
         protocol.send_msg(self.sock, "register", worker_id=worker_id,
-                          pid=_os.getpid(), driver=driver)
+                          pid=_os.getpid(), driver=driver, codec_ver=own_ver)
         self._recv_thread = threading.Thread(target=self._recv_loop, daemon=True)
         self._recv_thread.start()
         if driver:
-            hello = self._rpc("hello", timeout=10)
+            hello = self._rpc("hello", timeout=10, codec_ver=own_ver)
             if hello.get("arena"):
                 _os.environ["RAY_TPU_ARENA"] = hello["arena"]
                 _os.environ["RAY_TPU_STORE_BYTES"] = str(hello["store_bytes"])
             self.store = StoreClient()
             self.job_id = hello["job_id"]
+            self._codec_ver = min(own_ver, hello.get("codec_ver", 0))
 
     @property
     def current_task_id(self):
@@ -592,9 +606,14 @@ class WorkerClient(BaseClient):
                 os._exit(0)
 
     def _send_batch(self, entries):
-        """Flusher sink (lock held): one multi-entry frame for the batch."""
+        """Flusher sink (lock held): one multi-entry frame for the batch.
+        Consecutive incref/decref runs collapse into packed refdelta blobs
+        (bulk-applied by the controller's directory), and the frame goes out
+        natively coded when the handshake negotiated codec_ver > 0."""
         try:
-            protocol.send_msg(self.sock, "batch", entries=entries)
+            protocol.send_payload(
+                self.sock, "batch", {"entries": _codec.fold_refdeltas(entries)},
+                codec_on=self._codec_ver > 0)
         except OSError:
             pass  # controller gone: its crash reconciliation covers the rest
 
@@ -633,9 +652,11 @@ class WorkerClient(BaseClient):
              else max(spec.num_returns, 1))
         oids = [ids.object_id_for_return(spec.task_id, i) for i in range(n)]
         _note_ref_trace(oids[0], inherited)
-        # fire-and-forget; _send flushes first, so the spec can never
-        # overtake the put registrations of its own arguments
-        self._send("submit_async", spec=spec, result_oids=oids)
+        # fire-and-forget batch entry: append order keeps the spec behind
+        # the put registrations of its own arguments, and a tight submit
+        # loop shares one frame across many submits (non-urgent: blocking
+        # RPCs flush, so only fire-and-forget pays the coalescing nap)
+        self._flusher.append(("submit", spec, oids))
         return oids
 
     def get(self, oids, timeout=None):

@@ -58,6 +58,7 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from .. import exceptions as exc
+from .._native import codec as _codec
 from . import protocol
 from .task_spec import TaskSpec
 
@@ -100,6 +101,8 @@ class NodeConn:
     health: Dict[str, object] = field(default_factory=dict)
     hb_interval_s: float = 0.0
     hb_latency_s: float = 0.0
+    # negotiated native-codec version for frames TO this node (0 = pickle)
+    codec_ver: int = 0
 
 
 class ClusterServer:
@@ -213,12 +216,14 @@ class ClusterServer:
                         available=dict(p["resources"]),
                         host=p.get("host", ""), pid=p.get("pid", 0),
                         data_addr=p.get("data_addr", ""))
+        node.codec_ver = _codec.negotiate(p.get("codec_ver", 0))
         self.nodes[node.node_id] = node
         try:
             self.c.health.note_node_alive(node.node_id)
         except Exception:  # noqa: BLE001
             pass
-        protocol.awrite_msg(writer, "register_ok", head_node_id=self.c.node_id)
+        protocol.awrite_msg(writer, "register_ok", head_node_id=self.c.node_id,
+                            codec_ver=node.codec_ver)
         self.c._schedule()
         try:
             while True:
@@ -887,10 +892,10 @@ class ClusterServer:
                                   allow_restart=False)
         node.actors.clear()
         # drop the dead node from holder lists (fetches would just MISS and
-        # redistribute, but no point handing out known-dead sources)
-        for meta in c.objects.values():
-            if node.node_id in meta.holders:
-                meta.holders.remove(node.node_id)
+        # redistribute, but no point handing out known-dead sources) — one
+        # sharded sweep inside the directory instead of a pass over every
+        # ObjectMeta building a holder list per object
+        c.objdir.drop_node(node.node_id)
         # objects whose only copy lived there are lost; lineage reconstructs
         # on next access (meta stays, pull fails, _recover_object re-runs)
         c._schedule()

@@ -22,6 +22,8 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Set
 
 from .. import exceptions as exc
+from .._native import codec as _codec
+from .._native import objdir as _objdir
 from ..util import tracing
 from . import ids, protocol
 from .object_store import StoreClient
@@ -337,6 +339,9 @@ class WorkerConn:
     # reconciled (released) if the worker dies without the matching decrefs
     actor_refs: Dict[str, int] = field(default_factory=dict)
     stream_refs: Dict[str, int] = field(default_factory=dict)
+    # negotiated native-codec wire version for frames TO this peer (0 =
+    # pickle only); set from the register handshake's codec_ver
+    codec_ver: int = 0
 
 
 @dataclass
@@ -404,6 +409,10 @@ class Controller:
         self.max_workers = max_workers or (int(resources.get("CPU", 1)) + 2)
 
         self.objects: Dict[str, ObjectMeta] = {}
+        # id-sharded counter directory (native when the toolchain builds):
+        # ObjectMeta routes refcount/pinned/holders here; bulk paths
+        # (refdelta batches, node-death holder sweeps) hit it directly
+        self.objdir = _objdir.get_directory()
         self.object_events: Dict[str, asyncio.Event] = {}
         self.lineage: Dict[str, str] = {}  # evicted oid -> creating task id
         self.tasks: Dict[str, TaskRecord] = {}
@@ -464,6 +473,13 @@ class Controller:
         # evaluated from the reaper tick (see _private/health.py)
         from .health import HealthMonitor
         self.health = HealthMonitor(self)
+        # batch application defers the greedy dispatch loop to the end of
+        # the batch: one _schedule per frame instead of one per submit entry
+        self._sched_defer = 0
+        self._sched_dirty = False
+        # active only inside a _schedule pass: writer -> [framed exec bytes],
+        # joined into one transport write per worker at the end of the pass
+        self._dispatch_buf = None
         self._pulls: Dict[str, asyncio.Task] = {}  # in-flight remote pulls
         # eager dependency pulls (single-flight per oid, byte-capped); built
         # in start() once the event loop exists
@@ -538,6 +554,12 @@ class Controller:
                     os.remove(meta.spill_path)
                 except OSError:
                     pass
+        # the directory is process-global (back-to-back sessions in one
+        # process, e.g. tests): drop this session's entries
+        for oid in self.objects:
+            self.objdir.erase(oid)
+        for aid in self.actors:
+            self.objdir.erase(aid)
         self.objects.clear()
         if self.gcs is not None:
             self.gcs.close()
@@ -587,6 +609,10 @@ class Controller:
         w = self.spawning.pop(wid, None) or WorkerConn(worker_id=wid)
         w.writer = writer
         w.pid = msg[1].get("pid", 0)
+        # codec negotiation: what this peer can decode, capped by what we
+        # can encode. Receivers sniff every frame, so this only governs
+        # what either side may *send* (RAY_TPU_NATIVE=0 → 0 → all pickle).
+        w.codec_ver = _codec.negotiate(msg[1].get("codec_ver", 0))
         # an attached driver (ray_tpu.init(address=...), e.g. a submitted job)
         # shares the API surface over this socket but never executes tasks
         w.state = "driver" if msg[1].get("driver") else "idle"
@@ -662,7 +688,8 @@ class Controller:
             self._reply(w, p["req_id"],
                         arena=os.environ.get("RAY_TPU_ARENA"),
                         store_bytes=self.store_capacity,
-                        job_id=self.job_id, socket_path=self.socket_path)
+                        job_id=self.job_id, socket_path=self.socket_path,
+                        codec_ver=_codec.negotiate(p.get("codec_ver", 0)))
         elif kind == "state":
             try:
                 self._reply(w, p["req_id"], rows=self.state_snapshot(p["which"]))
@@ -747,10 +774,28 @@ class Controller:
             self.decref([oid])
 
     def _apply_batch(self, w: WorkerConn, entries):
+        self._sched_defer += 1
+        try:
+            self._apply_batch_inner(w, entries)
+        finally:
+            self._sched_defer -= 1
+            if self._sched_defer == 0 and self._sched_dirty:
+                self._sched_dirty = False
+                self._schedule()
+
+    def _apply_batch_inner(self, w: WorkerConn, entries):
         for e in entries:
             op = e[0]
             if op == "put":
                 self.register_put(e[1], e[2], e[3], e[4], e[5])
+            elif op == "refdeltas":
+                # packed incref/decref run (codec.fold_refdeltas / opcode 1):
+                # one bulk directory call instead of per-id entries
+                self._apply_refdeltas(e[1])
+            elif op == "submit":
+                # pipelined fire-and-forget submit riding the ordered batch
+                # (client-derived result ids; errors land in descriptors)
+                self.submit_pipelined(e[1], e[2])
             elif op == "incref":
                 self._worker_incref_one(w, e[1])
             elif op == "decref":
@@ -791,22 +836,55 @@ class Controller:
     def apply_batch_local(self, entries):
         """Driver-side batch: same entries, no per-worker tally (driver refs
         die with the session, exactly like the former direct calls)."""
-        for e in entries:
-            op = e[0]
-            if op == "put":
-                self.register_put(e[1], e[2], e[3], e[4], e[5])
-            elif op == "incref":
-                self.incref([e[1]])
-            elif op == "decref":
-                self.decref([e[1]])
-            elif op == "actor_incref":
-                self.actor_incref(e[1])
-            elif op == "actor_decref":
-                self.actor_decref(e[1])
-            elif op == "open_stream":
-                self.open_stream(e[1])
-            elif op == "close_stream":
-                self.close_stream(e[1])
+        self._sched_defer += 1
+        try:
+            for e in entries:
+                op = e[0]
+                if op == "put":
+                    self.register_put(e[1], e[2], e[3], e[4], e[5])
+                elif op == "refdeltas":
+                    self._apply_refdeltas(e[1])
+                elif op == "submit":
+                    self.submit_pipelined(e[1], e[2])
+                elif op == "incref":
+                    self.incref([e[1]])
+                elif op == "decref":
+                    self.decref([e[1]])
+                elif op == "actor_incref":
+                    self.actor_incref(e[1])
+                elif op == "actor_decref":
+                    self.actor_decref(e[1])
+                elif op == "open_stream":
+                    self.open_stream(e[1])
+                elif op == "close_stream":
+                    self.close_stream(e[1])
+        finally:
+            self._sched_defer -= 1
+            if self._sched_defer == 0 and self._sched_dirty:
+                self._sched_dirty = False
+                self._schedule()
+
+    def _apply_refdeltas(self, blob: bytes):
+        """Apply a packed incref/decref run through the sharded directory in
+        one call. fold_refdeltas only packs plain object ids ("obj-" prefix),
+        so the per-id prefix dispatch of incref()/decref() is not needed; the
+        directory skips unknown ids exactly like decref's objects.get miss.
+        Eviction verdicts come back per id with end-of-batch semantics: a
+        dec-to-zero revived by a later incref in the SAME batch stays alive
+        (the old per-entry path would have evicted at the crossing — the
+        batch is one atomic unit now, and both directory impls agree)."""
+        now = None
+        for oid, flags, rc in self.objdir.apply_deltas(blob):
+            meta = self.objects.get(oid)
+            if meta is None:
+                continue
+            meta._refcount = rc  # re-sync the mirror past the bulk write
+            if flags & _objdir.F_RELEASED and meta.ts_released == 0.0:
+                if now is None:
+                    now = time.time()
+                meta.ts_released = now
+            if flags & _objdir.F_EVICTABLE and meta.pinned == 0:
+                self._evict(oid)
 
     async def _worker_get(self, w, p):
         try:
@@ -1121,6 +1199,23 @@ class Controller:
         raylet's ScheduleAndDispatchTasks)."""
         if self._shutdown:
             return
+        if self._sched_defer:
+            self._sched_dirty = True  # batch application runs us once, at end
+            return
+        buf: Dict[object, list] = {}
+        self._dispatch_buf = buf
+        try:
+            self._schedule_pass()
+        finally:
+            self._dispatch_buf = None
+            for writer, frames in buf.items():
+                try:
+                    writer.write(frames[0] if len(frames) == 1
+                                 else b"".join(frames))
+                except (ConnectionResetError, BrokenPipeError, OSError):
+                    pass  # worker died mid-pass; the reaper handles it
+
+    def _schedule_pass(self):
         # 1. plain tasks → idle pool workers. The ready index returns the
         # earliest queued task whose demand fits its pool among signatures
         # with an idle matching worker; the mask is rebuilt per dispatch so
@@ -1539,6 +1634,9 @@ class Controller:
         wid = ids.worker_id()
         env = dict(os.environ)
         env["RAY_TPU_WORKER_ID"] = wid
+        # spawned workers have no reply channel on register: ship the codec
+        # ceiling in the env; the worker sends min(env, its own version)
+        env["RAY_TPU_CODEC_VER"] = str(_codec.wire_version())
         # joins worker log records to traces (logging_config.ContextFilter)
         env["RAY_TPU_NODE_ID"] = self.node_id
         # Propagate the driver's sys.path so by-reference cloudpickle (module
@@ -1619,12 +1717,20 @@ class Controller:
         if w.actor_id is None:
             w.state = "busy"
         if prefetch_enabled():
-            protocol.awrite_msg(w.writer, "exec", spec=rec.spec,
-                                result_oids=rec.result_oids,
-                                arg_descs=self._arg_descriptors(rec))
+            frame = protocol.frame_bytes("exec", dict(
+                spec=rec.spec, result_oids=rec.result_oids,
+                arg_descs=self._arg_descriptors(rec)))
         else:  # legacy frame, byte-identical to the pre-prefetch protocol
-            protocol.awrite_msg(w.writer, "exec", spec=rec.spec,
-                                result_oids=rec.result_oids)
+            frame = protocol.frame_bytes("exec", dict(
+                spec=rec.spec, result_oids=rec.result_oids))
+        buf = self._dispatch_buf
+        if buf is None:
+            w.writer.write(frame)
+        else:
+            # inside a _schedule pass: coalesce every exec frame bound for
+            # the same worker into one transport write (framing makes the
+            # byte stream identical either way)
+            buf.setdefault(w.writer, []).append(frame)
 
     # -------------------------------------------------------------- completion
     def _on_task_done(self, w: WorkerConn, p: dict):
@@ -2399,14 +2505,18 @@ class Controller:
     def actor_incref(self, actor_id: str):
         actor = self.actors.get(actor_id)
         if actor is not None and actor.state != A_DEAD:
-            actor.handle_refs += 1
+            # the sharded directory holds the authoritative count (actor ids
+            # shard alongside object ids); the record mirrors it for readers
+            v = self.objdir.add_refcount(actor_id, 1)
+            actor.handle_refs = v if v is not None else actor.handle_refs + 1
             actor.pending_gc = False
 
     def actor_decref(self, actor_id: str):
         actor = self.actors.get(actor_id)
         if actor is None or actor.state == A_DEAD:
             return
-        actor.handle_refs -= 1
+        v = self.objdir.add_refcount(actor_id, -1)
+        actor.handle_refs = v if v is not None else actor.handle_refs - 1
         if actor.handle_refs <= 0:
             self._maybe_gc_actor(actor)
 
@@ -2434,7 +2544,9 @@ class Controller:
     def _evict(self, oid: str):
         meta = self.objects.pop(oid, None)
         if meta is None:
+            self.objdir.erase(oid)  # self-heal a directory-only orphan
             return
+        self.objdir.erase(oid)  # counters freeze into the meta's mirrors
         if meta.location == "shm":
             self.store.delete_segment(oid)
             self.store_used -= meta.size
@@ -2632,6 +2744,8 @@ class Controller:
     def register_actor(self, spec: TaskSpec, options, _journal: bool = True) -> str:
         actor = ActorRecord(actor_id=spec.actor_id, creation_spec=spec, options=options,
                             name=options.name, namespace=options.namespace or "default")
+        # seed the directory with the creating handle's ref (handle_refs=1)
+        self.objdir.register(spec.actor_id, refcount=1, location="other:actor")
         if options.name:
             key = (actor.namespace, options.name)
             if key in self.named_actors:
@@ -2729,6 +2843,7 @@ class Controller:
             return
         actor.state = A_DEAD
         actor.death_reason = reason
+        self.objdir.erase(actor.actor_id)
         if self.gcs is not None:
             self.gcs.record("actor_dead", durable=True,
                             actor_id=actor.actor_id)

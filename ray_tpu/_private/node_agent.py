@@ -38,6 +38,7 @@ import time
 from typing import Dict, Optional
 
 from .. import exceptions as exc
+from .._native import codec as _codec
 from ..util import tracing
 from . import ids, paths, protocol
 from .cluster import HEARTBEAT_S, cluster_token
@@ -675,11 +676,16 @@ class NodeAgent:
                             node_id=self.c.node_id,
                             resources=dict(self.c.total),
                             host=_socket.gethostname(), pid=os.getpid(),
-                            data_addr=self.data_server.addr)
+                            data_addr=self.data_server.addr,
+                            codec_ver=_codec.wire_version())
         msg = await protocol.aread_msg(self.reader)
         if msg is None or msg[0] != "register_ok":
             raise ConnectionError("head rejected registration "
                                   "(bad RAY_TPU_CLUSTER_TOKEN?)")
+        # negotiated native-codec version for frames TO the head (the head
+        # echoes min(ours, its own); receivers sniff, so 0 is always safe)
+        self._codec_ver = min(_codec.wire_version(),
+                              msg[1].get("codec_ver", 0))
         print(f"[node] {self.c.node_id} joined head at "
               f"{self.head_host}:{self.head_port}", file=sys.stderr)
         self.c.loop.create_task(self._heartbeat())

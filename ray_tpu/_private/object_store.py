@@ -14,6 +14,7 @@ object table; an optional C++ slab store (src/shm_store.cpp) backs
 high-churn small objects.
 """
 
+import hashlib
 import os
 import weakref
 from multiprocessing import shared_memory, resource_tracker
@@ -53,9 +54,12 @@ def _unregister(shm):
 
 
 def seg_name(object_id: str) -> str:
-    # shm names are limited (~31 chars portable); object ids are longer, so use
-    # the stable unique suffix.
-    return "rtpu-" + object_id[-16:]
+    # shm names are limited (~31 chars portable); object ids are longer, and
+    # their formats put shared structure at both ends (per-process token,
+    # "-retN" suffixes), so no fixed slice of the id is collision-safe —
+    # hash the whole thing.
+    return "rtpu-" + hashlib.blake2b(object_id.encode(),
+                                     digest_size=8).hexdigest()
 
 
 # Per-process allocation-failure tally (health plane): bumped when the slab

@@ -1,19 +1,32 @@
-"""Length-prefixed pickle framing over unix sockets.
+"""Length-prefixed framing over unix sockets: pickle + negotiated native codec.
 
 Reference: Ray's control plane is gRPC (src/ray/rpc, src/ray/protobuf). For a
-single-host controller a unix socket with pickle framing has lower latency and
-zero codegen; the message *vocabulary* mirrors the reference's core-worker ↔
-raylet ↔ GCS RPCs (SubmitTask, PushTask reply, WaitForObjectEviction, ...).
+single-host controller a unix socket with length-prefixed framing has lower
+latency and zero codegen; the message *vocabulary* mirrors the reference's
+core-worker ↔ raylet ↔ GCS RPCs (SubmitTask, PushTask reply,
+WaitForObjectEviction, ...).
 
-Frame: u32 little-endian length | pickle payload. Messages are (kind, dict).
+Frame: u32 little-endian length | payload. Two payload encodings share the
+stream, distinguished by the first payload byte:
+
+- pickle of (kind, dict) — starts 0x80 (pickle protocol >= 2). The default,
+  and the only encoding for rare kinds (RPCs, replies, heartbeats).
+- native codec — starts 0xC3 (_native/codec.py, wire format pinned by
+  tests/test_frame_codec.py). Used for high-frequency "batch" frames when
+  both ends negotiated codec_ver > 0 in their register handshake.
+  RAY_TPU_NATIVE=0 turns this off entirely (all-pickle escape hatch).
+
+Receivers always sniff, so decoding never depends on the negotiation state;
+negotiation only governs what a sender may emit.
 
 Pipelined control plane additions:
-- the "batch" kind carries a list of coalesced refcount/put entries (see
-  client._DeltaFlusher / controller._apply_batch); it is an ordinary frame,
-  no wire-format change.
+- the "batch" kind carries a list of coalesced refcount/put/submit/task_done
+  entries (see client._DeltaFlusher / controller._apply_batch).
 - per-process counters tally frames by kind and blocking round trips, read
   through ray_tpu.util.metrics.control_plane_counters(); benchmarks and the
-  pipelining tests assert on deltas of these.
+  pipelining tests assert on deltas of these. Counters are kept in
+  per-thread tables merged lazily at read time — the old single-lock dict
+  serialized every send/recv across threads on the hot path.
 """
 
 import pickle
@@ -21,49 +34,100 @@ import struct
 import threading
 from typing import Dict
 
+from .._native import codec as _codec
+
 _HDR = struct.Struct("<I")
 
 # -- control-plane transport counters (per process) -------------------------
-# Plain dicts under one lock rather than util.metrics Counters: protocol.py
-# is imported while ray_tpu/__init__ is still executing, so it must not pull
-# in ray_tpu.util. util/metrics.py re-exposes these lazily.
-_counts_lock = threading.Lock()
-FRAMES_SENT: Dict[str, int] = {}
-FRAMES_RECEIVED: Dict[str, int] = {}
-ROUNDTRIPS: Dict[str, int] = {}
+# Plain dicts rather than util.metrics Counters: protocol.py is imported
+# while ray_tpu/__init__ is still executing, so it must not pull in
+# ray_tpu.util. util/metrics.py re-exposes these lazily.
+#
+# Sharded per thread: _bump touches only this thread's table (dict ops are
+# GIL-atomic, no lock), and readers merge every thread's table under
+# _tables_lock. Totals are exact for quiesced threads and at most one frame
+# stale for threads mid-send — fine for counters.
+_tables_lock = threading.Lock()
+_all_tables = []  # [(sent, received, roundtrips)] — one triple per thread
 
 
-def _bump(table: Dict[str, int], kind: str) -> None:
-    with _counts_lock:
-        table[kind] = table.get(kind, 0) + 1
+class _ThreadTables(threading.local):
+    def __init__(self):
+        self.sent: Dict[str, int] = {}
+        self.received: Dict[str, int] = {}
+        self.roundtrips: Dict[str, int] = {}
+        with _tables_lock:
+            _all_tables.append((self.sent, self.received, self.roundtrips))
+
+
+_tls = _ThreadTables()
+
+
+def _bump_sent(kind: str) -> None:
+    t = _tls.sent
+    t[kind] = t.get(kind, 0) + 1
+
+
+def _bump_received(kind: str) -> None:
+    t = _tls.received
+    t[kind] = t.get(kind, 0) + 1
 
 
 def note_roundtrip(kind: str) -> None:
     """Record one blocking control round trip (a request that waited for its
     reply — worker `_rpc` or a driver bridge call into the controller loop)."""
-    _bump(ROUNDTRIPS, kind)
+    t = _tls.roundtrips
+    t[kind] = t.get(kind, 0) + 1
+
+
+def _merged(idx: int) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    with _tables_lock:
+        tables = [t[idx] for t in _all_tables]
+    for table in tables:
+        for k, v in list(table.items()):
+            out[k] = out.get(k, 0) + v
+    return out
 
 
 def roundtrips_total() -> int:
-    with _counts_lock:
-        return sum(ROUNDTRIPS.values())
+    return sum(_merged(2).values())
 
 
 def frames_sent_total() -> int:
-    with _counts_lock:
-        return sum(FRAMES_SENT.values())
+    return sum(_merged(0).values())
 
 
 def counter_snapshot() -> Dict[str, Dict[str, int]]:
-    with _counts_lock:
-        return {"frames_sent": dict(FRAMES_SENT),
-                "frames_received": dict(FRAMES_RECEIVED),
-                "roundtrips": dict(ROUNDTRIPS)}
+    return {"frames_sent": _merged(0),
+            "frames_received": _merged(1),
+            "roundtrips": _merged(2)}
+
+
+def _encode(kind: str, payload: dict, codec_on: bool) -> bytes:
+    if codec_on:
+        data = _codec.encode(kind, payload)
+        if data is not None:
+            return data
+    return pickle.dumps((kind, payload), protocol=5)
+
+
+def _decode(data):
+    if data and data[0] == _codec.MAGIC:
+        return _codec.decode(data)
+    return pickle.loads(data)
 
 
 def send_msg(sock, kind: str, **payload):
-    data = pickle.dumps((kind, payload), protocol=5)
-    _bump(FRAMES_SENT, kind)
+    send_payload(sock, kind, payload)
+
+
+def send_payload(sock, kind: str, payload: dict, codec_on: bool = False):
+    """send_msg with an explicit payload dict + optional codec: high-rate
+    senders (the worker client's batch sink) pass codec_on=True once the
+    register handshake negotiated codec_ver > 0."""
+    data = _encode(kind, payload, codec_on)
+    _bump_sent(kind)
     sock.sendall(_HDR.pack(len(data)) + data)
 
 
@@ -75,8 +139,8 @@ def recv_msg(sock):
     data = _recv_exact(sock, n)
     if data is None:
         return None
-    msg = pickle.loads(data)
-    _bump(FRAMES_RECEIVED, msg[0])
+    msg = _decode(data)
+    _bump_received(msg[0])
     return msg
 
 
@@ -106,12 +170,26 @@ async def aread_msg(reader):
         data = await reader.readexactly(n)
     except (EOFError, ConnectionResetError, BrokenPipeError, OSError):
         return None
-    msg = pickle.loads(data)
-    _bump(FRAMES_RECEIVED, msg[0])
+    msg = _decode(data)
+    _bump_received(msg[0])
     return msg
 
 
+def frame_bytes(kind: str, payload: dict, codec_on: bool = False) -> bytes:
+    """Encode one framed message without writing it. Callers that fan many
+    frames at the same peer in one loop step (the scheduler's dispatch pass)
+    join these and hand the transport a single write — one syscall and one
+    GIL release instead of one per task."""
+    data = _encode(kind, payload, codec_on)
+    _bump_sent(kind)
+    return _HDR.pack(len(data)) + data
+
+
 def awrite_msg(writer, kind: str, **payload):
-    data = pickle.dumps((kind, payload), protocol=5)
-    _bump(FRAMES_SENT, kind)
+    awrite_payload(writer, kind, payload)
+
+
+def awrite_payload(writer, kind: str, payload: dict, codec_on: bool = False):
+    data = _encode(kind, payload, codec_on)
+    _bump_sent(kind)
     writer.write(_HDR.pack(len(data)) + data)

@@ -46,12 +46,22 @@ class _CollectRefs:
         _collector.ids = self._prev
 
 
+# Exact-type scalars take the plain-pickle fast path below: no cloudpickle
+# machinery, no buffer callback, no ref collection. Protocol-5 pickling of
+# these types never emits out-of-band buffers and the values cannot contain
+# ObjectRefs, so the result is byte-for-byte what the slow path would build.
+_SCALAR_TYPES = (int, float, bool, str, bytes, type(None))
+
+
 def dumps_oob(obj):
     """Serialize to (meta_bytes, list_of_buffers, contained_ref_ids).
 
     meta_bytes layout: u32 npickle | pickle | (u64 size)*nbuf — self-framing so
     a single contiguous shm write round-trips.
     """
+    if type(obj) in _SCALAR_TYPES:
+        payload = pickle.dumps(obj, protocol=5)
+        return struct.pack("<I", len(payload)) + payload, [], []
     buffers = []
 
     def callback(buf):

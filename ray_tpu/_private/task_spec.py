@@ -9,6 +9,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from .._native import objdir as _objdir
+
 
 # arg encodings: ("v", <packed bytes>) inline value | ("ref", object_id)
 Arg = Tuple[str, Any]
@@ -63,38 +65,165 @@ class ActorCreationOptions:
     resources: Dict[str, float] = field(default_factory=dict)
 
 
-@dataclass
+class _Holders:
+    """List-like view over the directory's holder set for one object id —
+    the head-side "extra nodes known to hold a copy" bookkeeping lives in
+    the sharded directory so heartbeat holds-object updates don't serialize
+    on the controller's dict (ISSUE 14)."""
+
+    __slots__ = ("_oid",)
+
+    def __init__(self, oid: str):
+        self._oid = oid
+
+    def _all(self) -> List[str]:
+        return _objdir.get_directory().holders(self._oid)
+
+    def append(self, node: str):
+        _objdir.get_directory().add_holder(self._oid, node)
+
+    def remove(self, node: str):
+        if not _objdir.get_directory().remove_holder(self._oid, node):
+            raise ValueError(f"{node!r} not in holders")
+
+    def __contains__(self, node) -> bool:
+        return node in self._all()
+
+    def __iter__(self):
+        return iter(self._all())
+
+    def __len__(self) -> int:
+        return len(self._all())
+
+    def __bool__(self) -> bool:
+        return bool(self._all())
+
+    def __eq__(self, other):
+        return list(self._all()) == list(other)
+
+    def __repr__(self):
+        return repr(self._all())
+
+
 class ObjectMeta:
     """Controller-side object table entry (ref: src/ray/gcs object table +
     plasma entry). location: 'pending' | 'shm' | 'inline' | 'spilled' |
-    'remote:<node_id>' (bytes authoritative in that node's store)."""
+    'remote:<node_id>' (bytes authoritative in that node's store).
 
-    object_id: str
-    size: int = 0
-    meta_len: int = 0            # header length inside the shm segment
-    location: str = "pending"
-    inline_value: Optional[bytes] = None
-    spill_path: Optional[str] = None
-    refcount: int = 1            # driver/borrower refs; 0 → evictable
-    pinned: int = 0              # in-flight task args pin objects
-    error: Optional[Exception] = None
-    creating_task: Optional[str] = None
-    # object ids serialized inside this object's bytes; each holds a refcount
-    # until this object is evicted (nested-ref containment)
-    contained: List[str] = field(default_factory=list)
-    # head-side only: nodes (beyond the authoritative `location`) known to
-    # hold a copy — extra sources for multi-peer parallel fetch. Best-effort:
-    # a stale holder just MISSes and the fetch redistributes.
-    holders: List[str] = field(default_factory=list)
-    # the local copy landed via an eager dependency pull (dispatch credits
-    # the pull's wall time to prefetch_overlap_saved_ms on first hit)
-    prefetched: bool = False
-    # lifetime ledger (health.ledger_ages / leak detector): created is
-    # stamped at table entry; sealed when bytes first land; pinned tracks
-    # the current pinned>0 stretch (cleared when the pin count returns to
-    # 0); released when the refcount first hits 0 — a released-but-pinned
-    # object lingering here is exactly the leak shape the detector flags
-    ts_created: float = field(default_factory=time.time)
-    ts_sealed: float = 0.0
-    ts_pinned: float = 0.0
-    ts_released: float = 0.0
+    Counter state — refcount, pinned, size, location, holders — is
+    authoritative in the process's id-sharded directory
+    (ray_tpu._native.objdir; C++ when the toolchain builds, the sharded
+    Python mirror otherwise). The attribute surface is unchanged: reads and
+    writes go through properties that hit the directory, so per-entry call
+    sites look exactly like the old dataclass while bulk paths
+    (od_apply_deltas decref storms, node-death holder sweeps) mutate the
+    same state without touching the meta at all. Rich Python state (inline
+    bytes, errors, lifetime timestamps) stays here.
+
+    refcount: driver/borrower refs; 0 → evictable. pinned: in-flight task
+    args pin objects. contained: object ids serialized inside this object's
+    bytes (nested-ref containment, released in _evict). ts_*: lifetime
+    ledger for health.ledger_ages / the leak detector."""
+
+    __slots__ = ("object_id", "meta_len", "inline_value", "spill_path",
+                 "error", "creating_task", "contained", "prefetched",
+                 "ts_created", "ts_sealed", "ts_pinned", "ts_released",
+                 "_location", "_refcount", "_pinned", "_size")
+
+    def __init__(self, object_id: str, size: int = 0, meta_len: int = 0,
+                 location: str = "pending",
+                 inline_value: Optional[bytes] = None,
+                 spill_path: Optional[str] = None, refcount: int = 1,
+                 pinned: int = 0, error: Optional[Exception] = None,
+                 creating_task: Optional[str] = None,
+                 contained: Optional[List[str]] = None,
+                 holders: Optional[List[str]] = None,
+                 prefetched: bool = False, ts_created: Optional[float] = None,
+                 ts_sealed: float = 0.0, ts_pinned: float = 0.0,
+                 ts_released: float = 0.0):
+        self.object_id = object_id
+        self.meta_len = meta_len
+        self.inline_value = inline_value
+        self.spill_path = spill_path
+        self.error = error
+        self.creating_task = creating_task
+        self.contained = list(contained) if contained else []
+        self.prefetched = prefetched
+        self.ts_created = time.time() if ts_created is None else ts_created
+        self.ts_sealed = ts_sealed
+        self.ts_pinned = ts_pinned
+        self.ts_released = ts_released
+        # local mirrors: fast reads for size/location, last-known fallback
+        # for refcount/pinned after the directory entry is erased
+        self._location = location
+        self._refcount = refcount
+        self._pinned = pinned
+        self._size = size
+        d = _objdir.get_directory()
+        d.register(object_id, refcount=refcount, pinned=pinned, size=size,
+                   location=location)
+        for node in holders or ():
+            d.add_holder(object_id, node)
+
+    # -- directory-backed counters ------------------------------------------
+    # Reads return the Python mirror; writes go through to the directory.
+    # Per-entry mutations all flow through these setters, and the one bulk
+    # path that bypasses them (od_apply_deltas) returns the final refcount
+    # per touched id so the controller re-syncs the mirror in the same pass.
+    # Reading via ctypes here was the hot-path killer: every foreign call
+    # released the GIL and handed the submit thread's slice to the flusher
+    # and loop threads (ISSUE 14 perf notes).
+    @property
+    def refcount(self) -> int:
+        return self._refcount
+
+    @refcount.setter
+    def refcount(self, v: int):
+        self._refcount = v
+        _objdir.get_directory().set_refcount(self.object_id, v)
+
+    @property
+    def pinned(self) -> int:
+        return self._pinned
+
+    @pinned.setter
+    def pinned(self, v: int):
+        self._pinned = v
+        _objdir.get_directory().set_pinned(self.object_id, v)
+
+    # size/location: the Python mirror is read (hot paths compare location
+    # strings constantly); every write goes through to the directory so its
+    # shard state — and anything reading it off-loop — stays exact.
+    @property
+    def size(self) -> int:
+        return self._size
+
+    @size.setter
+    def size(self, v: int):
+        self._size = v
+        _objdir.get_directory().set_size(self.object_id, v)
+
+    @property
+    def location(self) -> str:
+        return self._location
+
+    @location.setter
+    def location(self, v: str):
+        self._location = v
+        _objdir.get_directory().set_location(self.object_id, v)
+
+    @property
+    def holders(self) -> _Holders:
+        return _Holders(self.object_id)
+
+    @holders.setter
+    def holders(self, nodes):
+        d = _objdir.get_directory()
+        d.clear_holders(self.object_id)
+        for node in nodes:
+            d.add_holder(self.object_id, node)
+
+    def __repr__(self):
+        return (f"ObjectMeta({self.object_id!r}, location={self.location!r}, "
+                f"refcount={self.refcount}, pinned={self.pinned}, "
+                f"size={self.size})")

@@ -1,0 +1,117 @@
+// Native frame codec — structural scanner for the fixed-layout control
+// frames (ref: Ray's control plane speaks protobuf over gRPC, src/ray/rpc;
+// this repo's unix-socket plane replaces pickle with a packed layout for the
+// high-frequency frames and keeps pickle for the rare ones).
+//
+// Wire format v1 (byte-level golden tests pin this — tests/test_frame_codec.py):
+//
+//   frame: u8 magic 0xC3 | u8 version 1 | u8 kind | u32 nentries LE | entry*
+//   entry: u8 opcode | u32 body_len LE | body
+//
+// kind: 1 = "batch" (the only natively coded frame kind — task_done, submit
+// and refcount deltas all ride inside batch frames on the pipelined plane).
+// Pickle frames always start with 0x80 (protocol >= 2), so a receiver
+// distinguishes the two by the first byte alone.
+//
+// opcodes: 1 refdeltas (body = packed incref/decref run, the exact layout
+// obj_directory.cpp:od_apply_deltas consumes — a decoded body feeds the
+// directory with zero per-id Python objects) | 2 put | 3 actor_incref |
+// 4 actor_decref | 5 open_stream | 6 close_stream | 7 task_done | 8 submit |
+// 9 incref_one | 10 decref_one. Body layouts are parsed by the Python side
+// (ray_tpu/_native/codec.py); this file owns the one-pass entry scan and
+// bounds validation so decode does a single C call instead of per-entry
+// struct.unpack round trips.
+//
+// Flat C ABI for ctypes, no Python.h — same pattern as sched_queue.cpp.
+
+#include <cstdint>
+
+namespace {
+
+constexpr uint8_t kMagic = 0xC3;
+constexpr uint8_t kVersion = 1;
+constexpr uint8_t kKindBatch = 1;
+constexpr uint8_t kOpMax = 10;
+
+inline uint32_t rd_u32(const uint8_t* p) {
+  return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+         ((uint32_t)p[3] << 24);
+}
+
+}  // namespace
+
+extern "C" {
+
+int32_t fc_version() { return kVersion; }
+
+// Validate frame structure; returns the entry count, or a negative error:
+// -1 truncated/oversized, -2 bad magic, -3 unsupported version,
+// -4 unknown kind, -5 bad opcode.
+int64_t fc_validate(const uint8_t* buf, int64_t len) {
+  if (len < 7) return -1;
+  if (buf[0] != kMagic) return -2;
+  if (buf[1] != kVersion) return -3;
+  if (buf[2] != kKindBatch) return -4;
+  uint32_t n = rd_u32(buf + 3);
+  int64_t pos = 7;
+  for (uint32_t i = 0; i < n; i++) {
+    if (pos + 5 > len) return -1;
+    uint8_t op = buf[pos];
+    if (op < 1 || op > kOpMax) return -5;
+    uint32_t blen = rd_u32(buf + pos + 1);
+    pos += 5;
+    if (pos + (int64_t)blen > len) return -1;
+    pos += blen;
+  }
+  if (pos != len) return -1;  // trailing garbage
+  return (int64_t)n;
+}
+
+// One-pass scan: for each entry writes (opcode, body_offset, body_len) as
+// three int64 slots into `out` (capacity `cap_items` entries). Returns the
+// entry count, the same negative errors as fc_validate, or -6 when out is
+// too small.
+int64_t fc_scan(const uint8_t* buf, int64_t len, int64_t* out,
+                int64_t cap_items) {
+  if (len < 7) return -1;
+  if (buf[0] != kMagic) return -2;
+  if (buf[1] != kVersion) return -3;
+  if (buf[2] != kKindBatch) return -4;
+  uint32_t n = rd_u32(buf + 3);
+  if ((int64_t)n > cap_items) return -6;
+  int64_t pos = 7;
+  for (uint32_t i = 0; i < n; i++) {
+    if (pos + 5 > len) return -1;
+    uint8_t op = buf[pos];
+    if (op < 1 || op > kOpMax) return -5;
+    uint32_t blen = rd_u32(buf + pos + 1);
+    pos += 5;
+    if (pos + (int64_t)blen > len) return -1;
+    out[i * 3] = op;
+    out[i * 3 + 1] = pos;
+    out[i * 3 + 2] = blen;
+    pos += blen;
+  }
+  if (pos != len) return -1;
+  return (int64_t)n;
+}
+
+// Validate a packed refdelta run (the opcode-1 body / od_apply_deltas
+// input): repeat{ u8 op (1|2) | u16 idlen LE | id }. Returns the number of
+// delta records or -1 when malformed — the controller checks this before
+// handing an untrusted body to the directory.
+int64_t fc_validate_deltas(const uint8_t* buf, int64_t len) {
+  int64_t pos = 0, n = 0;
+  while (pos < len) {
+    if (pos + 3 > len) return -1;
+    uint8_t op = buf[pos];
+    if (op != 1 && op != 2) return -1;
+    uint16_t idlen = (uint16_t)(buf[pos + 1] | (buf[pos + 2] << 8));
+    pos += 3 + idlen;
+    if (pos > len) return -1;
+    n++;
+  }
+  return n;
+}
+
+}  // extern "C"

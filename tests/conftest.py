@@ -23,6 +23,26 @@ os.environ.setdefault("RAY_TPU_NUM_CHIPS", "0")
 import pytest
 
 
+def pytest_report_header(config):
+    """One visible line per native control-plane target: built or skipped
+    (tools/build_native.sh is the standalone spelling of the same check).
+    Tests exercise both paths — native when available, the pure-Python
+    fallbacks always — so a toolchain-less box still runs green, it just
+    says so here instead of silently testing half the matrix."""
+    rows = []
+    for name, modpath in [("shm_store", "ray_tpu._native.store"),
+                          ("sched_queue", "ray_tpu._native.schedq"),
+                          ("frame_codec", "ray_tpu._native.codec"),
+                          ("obj_directory", "ray_tpu._native.objdir")]:
+        try:
+            mod = __import__(modpath, fromlist=["_compile"])
+            mod._compile()
+            rows.append(f"{name}=built")
+        except Exception as e:  # noqa: BLE001 - the skip itself is the signal
+            rows.append(f"{name}=SKIP({str(e)[:60].strip()})")
+    return "native control plane: " + " ".join(rows)
+
+
 @pytest.fixture(scope="session")
 def ray_session():
     import ray_tpu

@@ -1,0 +1,281 @@
+"""Wire-format tests for the native frame codec (ISSUE 14 tentpole 1).
+
+The golden tests pin the v1 byte layout byte-for-byte: if any of them break,
+the wire format changed and VERSION must be bumped + negotiation handled —
+editing the expected bytes here is never the fix. The remaining tests cover
+roundtrips for every opcode, the native/python scanner equivalence, the
+pickle fallback for inexpressible payloads, first-byte sniffing in
+protocol._decode, and version negotiation incl. the RAY_TPU_NATIVE=0 hatch.
+"""
+
+import pickle
+import struct
+
+import pytest
+
+from ray_tpu._native import codec, objdir
+from ray_tpu._private import protocol
+from ray_tpu._private.task_spec import TaskSpec
+
+
+def _enc(entries):
+    data = codec.encode("batch", {"entries": entries})
+    assert data is not None, f"codec refused expressible entries: {entries!r}"
+    return data
+
+
+def _roundtrip(entries):
+    kind, payload = codec.decode(_enc(entries))
+    assert kind == "batch"
+    return payload["entries"]
+
+
+# ------------------------------------------------------------ golden frames
+
+def test_golden_refdeltas_frame():
+    # incref/decref runs on obj- ids fold into ONE refdeltas entry whose body
+    # is the packed delta-run layout: repeat{u8 op | u16 idlen | id}.
+    data = _enc([("incref", "obj-a"), ("decref", "obj-a"),
+                 ("decref", "obj-b")])
+    expect = bytes.fromhex(
+        "c30101"            # magic 0xC3 | version 1 | kind batch
+        "01000000"          # nentries = 1 (u32 LE)
+        "01"                # opcode 1 = refdeltas
+        "18000000"          # body_len = 24
+        "010500" "6f626a2d61"   # INCREF | len 5 | "obj-a"
+        "020500" "6f626a2d61"   # DECREF | len 5 | "obj-a"
+        "020500" "6f626a2d62")  # DECREF | len 5 | "obj-b"
+    assert data == expect
+
+
+def test_golden_put_frame():
+    data = _enc([("put", "obj-z", 12, 4096, b"hi", ["obj-c1"])])
+    expect = bytes.fromhex(
+        "c30101" "01000000"
+        "02"                # opcode 2 = put
+        "24000000"          # body_len = 36
+        "0500" "6f626a2d7a"     # str "obj-z"
+        "0c000000"              # meta_len = 12 (u32)
+        "0010000000000000"      # size = 4096 (u64)
+        "01" "02000000" "6869"  # inline present | len 2 | "hi"
+        "0100"                  # 1 contained id
+        "0600" "6f626a2d6331")  # str "obj-c1"
+    assert data == expect
+
+
+def test_golden_actor_incref_frame():
+    data = _enc([("actor_incref", "actor-7")])
+    expect = bytes.fromhex(
+        "c30101" "01000000"
+        "03"                # opcode 3 = actor_incref
+        "09000000"          # body_len = 9
+        "0700" "6163746f722d37")  # str "actor-7"
+    assert data == expect
+
+
+def test_golden_header_constants():
+    assert codec.MAGIC == 0xC3
+    assert codec.VERSION == 1
+    assert codec.KIND_BATCH == 1
+    # opcode numbering is wire ABI — reordering breaks cross-version peers
+    assert (codec.OP_REFDELTAS, codec.OP_PUT, codec.OP_ACTOR_INCREF,
+            codec.OP_ACTOR_DECREF, codec.OP_OPEN_STREAM,
+            codec.OP_CLOSE_STREAM, codec.OP_TASK_DONE, codec.OP_SUBMIT,
+            codec.OP_INCREF_ONE, codec.OP_DECREF_ONE) == tuple(range(1, 11))
+
+
+def test_golden_fold_preserves_order():
+    # put-before-decref ordering must survive folding: the run is split
+    # around the put, not hoisted across it.
+    data = _enc([("incref", "obj-a"),
+                 ("put", "obj-p", 0, 0, None, []),
+                 ("decref", "obj-a")])
+    (n,) = struct.unpack_from("<I", data, 3)
+    assert n == 3
+    ops = [op for op, _, _ in codec._scan_py(data)]
+    assert ops == [codec.OP_REFDELTAS, codec.OP_PUT, codec.OP_REFDELTAS]
+
+
+# --------------------------------------------------------------- roundtrips
+
+def test_roundtrip_put_and_refs():
+    entries = [("put", "obj-z", 12, 4096, b"inline", ["obj-c1", "obj-c2"]),
+               ("incref", "obj-z"), ("decref", "obj-c1")]
+    out = _roundtrip(entries)
+    assert out[0] == ("put", "obj-z", 12, 4096, b"inline",
+                      ["obj-c1", "obj-c2"])
+    # the ref run comes back as one packed refdeltas entry the controller
+    # hands straight to the directory
+    assert out[1][0] == "refdeltas"
+    assert objdir.pack_deltas([(objdir.INCREF, "obj-z"),
+                               (objdir.DECREF, "obj-c1")]) == out[1][1]
+
+
+def test_roundtrip_task_done():
+    entries = [("task_done", "task-1",
+                [("obj-r0", 8, 100, None, []),
+                 ("obj-r1", 3, 7, b"\x00\x01", ["obj-n"])],
+                None, None, None)]
+    assert _roundtrip(entries) == entries
+
+
+def test_roundtrip_task_done_error_and_spans():
+    err = ValueError("boom")
+    span = {"task_id": "task-2", "t0": 1.5}
+    spans = [{"name": "exec"}]
+    (out,) = _roundtrip([("task_done", "task-2", [], err, span, spans)])
+    assert out[0] == "task_done" and out[1] == "task-2" and out[2] == []
+    assert type(out[3]) is ValueError and out[3].args == ("boom",)
+    assert out[4] == span and out[5] == spans
+
+
+def test_roundtrip_submit_plain():
+    spec = TaskSpec(task_id="task-9", fn_blob=b"\x80blob",
+                    args=[("v", b"payload"), ("ref", "obj-a")],
+                    kwargs={"k": ("v", b"vv")},
+                    num_returns=2, resources={"CPU": 1.0, "TPU": 0.5},
+                    max_retries=3, retry_exceptions=False, name="f")
+    (out,) = _roundtrip([("submit", spec, ["obj-r0", "obj-r1"])])
+    assert out[0] == "submit" and out[2] == ["obj-r0", "obj-r1"]
+    got = out[1]
+    for f in ("task_id", "fn_blob", "args", "kwargs", "num_returns",
+              "resources", "max_retries", "retry_exceptions", "name"):
+        assert getattr(got, f) == getattr(spec, f), f
+
+
+def test_roundtrip_submit_extras_and_streaming():
+    # non-default rare fields ride the pickled extras blob
+    spec = TaskSpec(task_id="task-a", fn_blob=None, num_returns="streaming",
+                    actor_id="actor-1", method_name="step",
+                    scheduling_strategy="SPREAD", runtime_env={"env_vars": {}},
+                    generator_backpressure=4, parent_task_id="task-p",
+                    job_id="job-1", trace_id="tr", parent_span_id=7,
+                    nested_refs=["obj-n"])
+    (out,) = _roundtrip([("submit", spec, [])])
+    got = out[1]
+    for f in ("num_returns", "actor_id", "method_name", "scheduling_strategy",
+              "runtime_env", "generator_backpressure", "parent_task_id",
+              "job_id", "trace_id", "parent_span_id", "nested_refs"):
+        assert getattr(got, f) == getattr(spec, f), f
+
+
+def test_roundtrip_stream_and_actor_ops():
+    entries = [("open_stream", "task-s"), ("close_stream", "task-s"),
+               ("actor_incref", "actor-1"), ("actor_decref", "actor-1"),
+               ("incref", "act-x"), ("decref", "act-x")]
+    # act-x doesn't start with obj- so the incref/decref stay scalar entries
+    assert _roundtrip(entries) == entries
+
+
+def test_roundtrip_empty_batch():
+    assert _roundtrip([]) == []
+
+
+# --------------------------------------------------------- pickle fallback
+
+def test_encode_refuses_non_batch_kinds():
+    assert codec.encode("register", {"worker_id": "w"}) is None
+    assert codec.encode("batch", {"entries": [], "extra": 1}) is None
+
+
+def test_encode_refuses_inexpressible_entries():
+    # unknown entry op
+    assert codec.encode("batch", {"entries": [("mystery", "x")]}) is None
+    # oversized id blows the u16 length field
+    assert codec.encode(
+        "batch", {"entries": [("incref", "act-" + "x" * 70000)]}) is None
+    # non-bool retry_exceptions has no fixed layout
+    spec = TaskSpec(task_id="t", fn_blob=None, retry_exceptions=(ValueError,))
+    assert codec.encode("batch", {"entries": [("submit", spec, [])]}) is None
+
+
+def test_protocol_encode_falls_back_to_pickle():
+    # codec_on + inexpressible payload → pickled bytes (first byte 0x80)
+    data = protocol._encode("batch", {"entries": [("mystery", 1)]}, True)
+    assert data[0] == 0x80
+    assert pickle.loads(data) == ("batch", {"entries": [("mystery", 1)]})
+    # codec off → always pickle, even for codec-able payloads
+    data = protocol._encode("batch", {"entries": [("incref", "obj-a")]}, False)
+    assert data[0] == 0x80
+
+
+def test_protocol_decode_sniffs_first_byte():
+    raw = _enc([("decref", "obj-a")])
+    assert raw[0] == codec.MAGIC
+    kind, payload = protocol._decode(raw)
+    assert kind == "batch" and payload["entries"][0][0] == "refdeltas"
+    # pickle frames (0x80...) still decode through pickle
+    assert protocol._decode(pickle.dumps(("ping", {"x": 1}), protocol=5)) \
+        == ("ping", {"x": 1})
+
+
+def test_frame_bytes_matches_send_encoding():
+    framed = protocol.frame_bytes("batch", {"entries": [("incref", "obj-a")]},
+                                  codec_on=True)
+    (n,) = struct.unpack_from("<I", framed, 0)
+    body = framed[4:]
+    assert len(body) == n
+    assert body == _enc([("incref", "obj-a")])
+
+
+# ------------------------------------------------- native scanner parity
+
+def test_scan_native_matches_python():
+    if not codec.native_available():
+        pytest.skip("no toolchain: python scanner is the only implementation")
+    lib = codec._load()
+    frames = [
+        _enc([]),
+        _enc([("incref", "obj-a"), ("decref", "obj-b")]),
+        _enc([("put", "obj-z", 12, 4096, b"hi", ["obj-c1"]),
+              ("task_done", "task-1", [("obj-r0", 8, 100, None, [])],
+               None, None, None),
+              ("open_stream", "task-1")]),
+    ]
+    for data in frames:
+        assert codec._scan_native(lib, data) == codec._scan_py(data)
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda d: d[:-1],                       # truncated body
+    lambda d: d[:3] + b"\xff\xff\xff\xff" + d[7:],  # nentries lies
+    lambda d: d[:7] + b"\x63" + d[8:],      # opcode out of range
+    lambda d: d + b"\x00",                  # trailing garbage
+])
+def test_malformed_frames_rejected_by_both_scanners(mutate):
+    data = mutate(_enc([("incref", "obj-a"), ("decref", "obj-b")]))
+    with pytest.raises(ValueError):
+        codec._scan_py(data)
+    if codec.native_available():
+        with pytest.raises(ValueError):
+            codec._scan_native(codec._load(), data)
+
+
+def test_fc_version_matches_python_version():
+    if not codec.native_available():
+        pytest.skip("no toolchain")
+    assert codec._load().fc_version() == codec.VERSION
+
+
+# ------------------------------------------------------------- negotiation
+
+def test_negotiate_takes_min():
+    assert codec.wire_version() in (0, codec.VERSION)
+    if codec.wire_version() == codec.VERSION:
+        assert codec.negotiate(1) == 1
+        assert codec.negotiate(99) == codec.VERSION
+    assert codec.negotiate(0) == 0
+    assert codec.negotiate(None) == 0
+    assert codec.negotiate("garbage") == 0
+
+
+def test_native_disabled_forces_pickle(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_NATIVE", "0")
+    assert codec.native_disabled()
+    assert codec.wire_version() == 0
+    assert codec.negotiate(1) == 0
+    # decode stays available even when disabled: a peer may still be
+    # mid-handshake and no frame may ever be dropped
+    raw = _enc([("incref", "obj-a")])
+    kind, payload = codec.decode(raw)
+    assert kind == "batch" and payload["entries"][0][0] == "refdeltas"
