@@ -35,7 +35,8 @@ llama_125m, so the round always records SOME number with rc=0. The final JSON
 line is the merged record:
 {"metric", "value", "unit", "vs_baseline", "mfu", "backend", ...,
  "serving_b8": {...}, "serving_b32": {...}, "rllib_ppo": {...},
- "core_cp": {...}, "transfer_dp": {...}, "chain_dp": {...}}.
+ "rllib_sebulba": {...}, "core_cp": {...}, "transfer_dp": {...},
+ "chain_dp": {...}}.
 vs_baseline compares against the newest prior BENCH_r*.json with the same
 metric name (the reference fork publishes no numbers — BASELINE.json
 "published" is {} — so our own history is the baseline).
@@ -632,7 +633,10 @@ def orchestrate():
         for key, script, tmo, extra in (
                 ("serving_b8", "serving_bench.py", 900, {"B": "8"}),
                 ("serving_b32", "serving_bench.py", 900, {"B": "32"}),
-                ("rllib_ppo", "rllib_bench.py", 600, None),
+                ("rllib_ppo", "rllib_bench.py", 600,
+                 {"RLLIB_BENCH_SECTION": "ppo"}),
+                ("rllib_sebulba", "rllib_bench.py", 600,
+                 {"RLLIB_BENCH_SECTION": "sebulba"}),
                 ("core_cp", "core_bench.py", 300, None),
                 ("transfer_dp", "transfer_bench.py", 300, None),
                 ("chain_dp", "chain_bench.py", 300, None),

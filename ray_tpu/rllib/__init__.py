@@ -10,16 +10,19 @@ from .algorithms import (APPO, APPOConfig, BC, BCConfig, CQL, CQLConfig, DQN,
                          DQNConfig, DreamerV3, DreamerV3Config, IMPALA,
                          IMPALAConfig, IQL, IQLConfig, MARWIL, MARWILConfig,
                          PPO, PPOConfig, SAC, SACConfig, TQC, TQCConfig)
-from .buffers import PrioritizedReplayBuffer, ReplayBuffer
+from .buffers import PrioritizedReplayBuffer, ReplayActor, ReplayBuffer
 from .env_runner import EnvRunner
 from .learner import JaxLearner, LearnerGroup, make_learner_group
 from .rl_module import ModuleSpec, RLModule
 from .sample_batch import SampleBatch
+from .sebulba import (DeviceRollout, JaxCartPole, RolloutActor,
+                      SebulbaPipeline)
 
 __all__ = [
     "Algorithm", "AlgorithmConfig", "EnvRunner", "JaxLearner",
     "LearnerGroup", "ModuleSpec", "RLModule", "SampleBatch",
-    "ReplayBuffer", "PrioritizedReplayBuffer",
+    "ReplayBuffer", "PrioritizedReplayBuffer", "ReplayActor",
+    "SebulbaPipeline", "RolloutActor", "DeviceRollout", "JaxCartPole",
     "PPO", "PPOConfig", "APPO", "APPOConfig", "DQN", "DQNConfig",
     "IMPALA", "IMPALAConfig", "SAC", "SACConfig", "BC", "BCConfig",
     "MARWIL", "MARWILConfig", "CQL", "CQLConfig", "IQL", "IQLConfig",

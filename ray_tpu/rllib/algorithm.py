@@ -58,6 +58,19 @@ class AlgorithmConfig:
         self.evaluation_duration = 5
         self.evaluation_num_env_runners = 0
         self.evaluation_parallel_to_training = False
+        # sebulba pipeline (async rollout→replay→learner; rllib/sebulba.py)
+        self.sebulba_enabled = False
+        self.sebulba_num_rollout_actors = 2
+        self.sebulba_inflight_rollouts = 2
+        self.sebulba_replay_capacity = 64
+        self.sebulba_replay_mode = "uniform"   # or "fifo"
+        self.sebulba_sample_batch_count = 1    # trajectories per update
+        self.sebulba_min_replay = 1
+        self.sebulba_broadcast_interval = 1    # updates per param broadcast
+        self.sebulba_max_staleness = None      # drop samples older than this
+        self.sebulba_lockstep = False          # sync-parity schedule
+        self.sebulba_replay_seed = None        # defaults to config.seed
+        self.sebulba_jax_env = None            # e.g. "cartpole" (device path)
         # misc
         self.seed = 0
         self.framework_str = "jax"
@@ -146,6 +159,41 @@ class AlgorithmConfig:
             self.evaluation_parallel_to_training = evaluation_parallel_to_training
         return self
 
+    def sebulba(self, *, enabled: bool = True, num_rollout_actors=None,
+                inflight_rollouts=None, replay_capacity=None,
+                replay_mode=None, sample_batch_count=None, min_replay=None,
+                broadcast_interval=None, max_staleness=None, lockstep=None,
+                replay_seed=None, jax_env=None, **_):
+        """Run collection through the sebulba pipeline (Podracer,
+        arXiv:2104.06272): device-resident/actor rollouts → ref-based
+        replay → async V-trace learner with versioned fire-and-forget
+        param broadcast. Only off-policy-tolerant algorithms (IMPALA,
+        APPO) accept it."""
+        self.sebulba_enabled = bool(enabled)
+        if num_rollout_actors is not None:
+            self.sebulba_num_rollout_actors = num_rollout_actors
+        if inflight_rollouts is not None:
+            self.sebulba_inflight_rollouts = inflight_rollouts
+        if replay_capacity is not None:
+            self.sebulba_replay_capacity = replay_capacity
+        if replay_mode is not None:
+            self.sebulba_replay_mode = replay_mode
+        if sample_batch_count is not None:
+            self.sebulba_sample_batch_count = sample_batch_count
+        if min_replay is not None:
+            self.sebulba_min_replay = min_replay
+        if broadcast_interval is not None:
+            self.sebulba_broadcast_interval = broadcast_interval
+        if max_staleness is not None:
+            self.sebulba_max_staleness = max_staleness
+        if lockstep is not None:
+            self.sebulba_lockstep = lockstep
+        if replay_seed is not None:
+            self.sebulba_replay_seed = replay_seed
+        if jax_env is not None:
+            self.sebulba_jax_env = jax_env
+        return self
+
     def framework(self, framework: str = "jax", **_):
         if framework not in ("jax", "tf2", "torch"):
             raise ValueError(framework)
@@ -191,6 +239,10 @@ class Algorithm:
         self._pending_eval = None           # in-flight parallel eval refs
         self.setup(config)
         self._setup_eval_runners()
+        self._sebulba = None
+        if getattr(config, "sebulba_enabled", False):
+            from .sebulba import SebulbaPipeline
+            self._sebulba = SebulbaPipeline(self, config)
 
     # -- runner fleet --------------------------------------------------------
     def _make_runner_kwargs(self) -> Dict[str, Any]:
@@ -260,6 +312,15 @@ class Algorithm:
     # algorithms whose evaluate() cannot run on a generic EnvRunner (custom
     # weight layouts / multi-agent) opt out of the dedicated-actor path
     _supports_eval_actors = True
+    # the sebulba pipeline replays data collected under OLDER params, so
+    # only algorithms with an off-policy correction (V-trace) opt in
+    _supports_sebulba = False
+
+    def _sebulba_update(self, batch: SampleBatch) -> Dict[str, float]:
+        """One learner update on a replay-sampled [T, B] batch — the
+        sebulba pipeline's learn stage. Algorithms needing driver-side
+        preprocessing (APPO's V-trace targets) override this."""
+        return self.learner_group.update(batch)
 
     def _eval_runner_kwargs(self) -> Dict[str, Any]:
         """Same construction as the training runners (module overrides from
@@ -293,7 +354,8 @@ class Algorithm:
         import math
         t0 = time.perf_counter()
         self._env_steps_iter = 0
-        result = self.training_step()
+        result = (self._sebulba.training_step() if self._sebulba is not None
+                  else self.training_step())
         self.iteration += 1
         result.setdefault("training_iteration", self.iteration)
         # env-step accounting (ref: num_env_steps_sampled_* in result dicts)
@@ -376,6 +438,9 @@ class Algorithm:
         self.set_state(ckpt.to_state())
 
     def stop(self):
+        if getattr(self, "_sebulba", None) is not None:
+            self._sebulba.shutdown()
+            self._sebulba = None
         if self._local_runner:
             self._local_runner.close()
         for h in self._runner_handles:

@@ -26,6 +26,8 @@ class APPOConfig(PPOConfig):
 
 
 class APPO(PPO):
+    _supports_sebulba = True
+
     def setup(self, config: APPOConfig):
         super().setup(config)
         spec = self._local_runner.get_spec()
@@ -49,6 +51,19 @@ class APPO(PPO):
             return vt.pg_advantages, vt.vs
 
         self._targets = jax.jit(targets)
+
+    def _sebulba_update(self, batch) -> Dict:
+        """Sebulba learn stage: V-trace targets under CURRENT params (the
+        correction that absorbs the pipeline's off-policy gap), then the
+        clipped-surrogate update."""
+        adv, vs = self._targets(self.learner.params, dict(
+            {k: batch[k] for k in (SB.OBS, SB.ACTIONS, SB.LOGP, SB.REWARDS,
+                                   SB.DONES, SB.BOOTSTRAP_VALUE)}))
+        batch[SB.ADVANTAGES] = np.asarray(adv)
+        batch[SB.VALUE_TARGETS] = np.asarray(vs)
+        if self.config.standardize_advantages:
+            batch = standardize_advantages(batch)
+        return self.learner_group.update(batch)
 
     def training_step(self) -> Dict:
         cfg = self.config

@@ -68,6 +68,10 @@ class IMPALALearner(JaxLearner):
 
 
 class IMPALA(Algorithm):
+    # V-trace already corrects for stale behavior policies, so replayed
+    # sebulba trajectories (gap ≥ 1) are exactly the intended input
+    _supports_sebulba = True
+
     def setup(self, config: IMPALAConfig):
         self._setup_runners()
         spec = self._local_runner.get_spec()

@@ -3,8 +3,16 @@ rllib/env/single_agent_env_runner.py).
 
 Runs gymnasium vector envs and the policy's CPU forward (jax on the host
 platform — the TPU stays dedicated to the learner). Emits fixed-shape
-[T, B] SampleBatches so the learner's jitted update never recompiles.
-Deployable as a ray_tpu actor (`num_env_runners > 0`) or called inline.
+[T, B] SampleBatches so the learner's jitted update never recompiles —
+this shape contract is load-bearing: the sebulba pipeline asserts the
+learner's jit cache holds exactly one entry across a whole run
+(`JaxLearner.jit_cache_size`). Deployable as a ray_tpu actor
+(`num_env_runners > 0`) or called inline.
+
+Weights may carry a params VERSION (`set_weights(params, version=n)`):
+the async sebulba pipeline stamps every trajectory with the version it
+was collected under, giving the learner the exact off-policy gap its
+V-trace correction is accounting for.
 """
 
 import functools
@@ -64,6 +72,7 @@ class EnvRunner:
         # interface: init/forward/explore_step/inference_step + .spec
         self.module = module if module is not None else RLModule(spec)
         self.params = None
+        self.params_version = -1  # -1 = never versioned (sync path)
         self._step_count = 0
         self._seed = seed
         # episode bookkeeping for metrics
@@ -75,8 +84,16 @@ class EnvRunner:
         self._jit_values = None
 
     # -- weights ------------------------------------------------------------
-    def set_weights(self, params):
+    def set_weights(self, params, version: Optional[int] = None):
         self.params = params
+        if version is not None:
+            self.params_version = int(version)
+            from ray_tpu.util import metrics
+            metrics.get_or_create(
+                metrics.Gauge, "rllib_param_version",
+                "params version in use (learner: published; "
+                "rollout: received)", tag_keys=("role",)).set(
+                    self.params_version, tags={"role": "rollout"})
 
     def get_spec(self) -> ModuleSpec:
         return self.module.spec

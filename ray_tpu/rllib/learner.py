@@ -92,6 +92,17 @@ class JaxLearner:
         metrics averaged over SGD steps."""
         return _host_metrics([self.update_once(dict(batch))])
 
+    def jit_cache_size(self) -> int:
+        """Compiled-variant count of the jitted update — the recompile
+        guard. Fixed-shape [T, B] batches (the contract env_runner.py
+        documents) mean exactly ONE entry across a whole run; a second
+        entry is a shape/dtype leak that silently recompiles on the hot
+        path (sebulba asserts ==1 after every pipeline run)."""
+        try:
+            return int(self._update._cache_size())
+        except Exception:  # noqa: BLE001 - private jax API moved
+            return -1
+
     # -- weights -------------------------------------------------------------
     def get_weights(self):
         import jax

@@ -1,0 +1,659 @@
+"""Sebulba-style anytime RL pipeline (Podracer architectures,
+arXiv:2104.06272; Sebulba is the actor-learner decomposition, Anakin the
+single-program variant).
+
+Three roles, fully decoupled, built on the actor fabric:
+
+* ``RolloutActor`` — runs a single jitted act+env-step loop and seals
+  fixed-shape [T, B] trajectory objects directly into its local object
+  store. Two rollout backends share one surface: the gymnasium
+  ``EnvRunner`` (CPU vector envs, per-step jitted policy) and
+  ``DeviceRollout`` (a pure-jax env where the WHOLE T-step unroll is one
+  ``lax.scan`` on the accelerator — the Anakin-style device-resident
+  path). Every trajectory is stamped with the params VERSION it was
+  collected under.
+
+* ``ReplayActor`` (rllib/buffers.py) — admits and samples trajectories
+  as object-store REFS. Trajectory bytes never pass through the driver
+  or the replay actor: the driver forwards refs in, the learner fetches
+  sampled refs straight from the producing node's store.
+
+* ``SebulbaPipeline`` — the driver-side learner loop. It keeps each
+  rollout actor saturated with in-flight sample calls, admits finished
+  trajectories to replay, prefetch-overlaps the next sampled batch with
+  the current jitted update, and publishes versioned params via
+  fire-and-forget broadcast. ``learner_version - trajectory_version`` is
+  the EXACT off-policy gap the V-trace correction is accounting for
+  (observed into the ``rllib_offpolicy_gap`` histogram).
+
+Determinism: replay sampling is seeded from the config
+(``sebulba_replay_seed``, default ``config.seed``) and rollout RNG is a
+counter-folded key, so a pipeline run is reproducible. ``lockstep`` mode
+(1 actor, 1 in-flight rollout, fifo replay, blocking broadcast every
+update) degenerates the async pipeline into the exact synchronous
+IMPALA schedule — the parity anchor the tests pin against the sync path.
+
+Observability: rollout and learn stages ship ``pipeline.act`` /
+``pipeline.learn`` spans through the worker outbox (util/tracing.py), so
+``python -m ray_tpu timeline`` shows the rollout/replay/learn overlap;
+``tracing.overlap_stats`` quantifies it and the bench gate asserts it.
+"""
+
+import math
+import os
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ray_tpu.actor import method
+from . import sample_batch as SB
+from .algorithm import _merge_runner_metrics
+from .buffers import ReplayActor
+from .env_runner import EnvRunner
+from .rl_module import ModuleSpec, RLModule
+from .sample_batch import SampleBatch
+
+__all__ = ["JaxCartPole", "DeviceRollout", "RolloutActor", "SebulbaPipeline"]
+
+
+# ---------------------------------------------------------------------------
+# device-resident rollouts
+# ---------------------------------------------------------------------------
+
+class JaxCartPole:
+    """CartPole-v1 as pure jax functions (classic-control physics,
+    Barto-Sutton-Anderson '83) so an entire rollout can live inside one
+    jitted ``lax.scan`` — state is [B, 4] arrays, auto-reset is a
+    ``where`` on the done mask. Matches gymnasium's SAME_STEP autoreset
+    semantics: the obs recorded at step t is the pre-step obs, and a
+    finished env's NEXT obs is the reset obs."""
+
+    GRAV, MASSCART, MASSPOLE = 9.8, 1.0, 0.1
+    TOTAL_MASS = MASSCART + MASSPOLE
+    LENGTH = 0.5                       # half the pole's length
+    POLEMASS_LENGTH = MASSPOLE * LENGTH
+    FORCE_MAG, TAU = 10.0, 0.02
+    X_LIM = 2.4
+    THETA_LIM = 12 * 2 * math.pi / 360
+    MAX_STEPS = 500
+
+    @staticmethod
+    def spec() -> ModuleSpec:
+        return ModuleSpec((4,), "discrete", 2)
+
+    @staticmethod
+    def reset(key, batch: int):
+        import jax
+        import jax.numpy as jnp
+        x = jax.random.uniform(key, (batch, 4), minval=-0.05, maxval=0.05)
+        return x.astype(jnp.float32), jnp.zeros((batch,), jnp.int32)
+
+    @staticmethod
+    def observe(x):
+        return x
+
+    @classmethod
+    def step(cls, x, t, action):
+        import jax.numpy as jnp
+        pos, vel, theta, theta_dot = x[:, 0], x[:, 1], x[:, 2], x[:, 3]
+        force = jnp.where(action == 1, cls.FORCE_MAG, -cls.FORCE_MAG)
+        costh, sinth = jnp.cos(theta), jnp.sin(theta)
+        temp = (force + cls.POLEMASS_LENGTH * theta_dot ** 2 * sinth) \
+            / cls.TOTAL_MASS
+        theta_acc = (cls.GRAV * sinth - costh * temp) / (
+            cls.LENGTH * (4.0 / 3.0
+                          - cls.MASSPOLE * costh ** 2 / cls.TOTAL_MASS))
+        x_acc = temp - cls.POLEMASS_LENGTH * theta_acc * costh / cls.TOTAL_MASS
+        pos = pos + cls.TAU * vel
+        vel = vel + cls.TAU * x_acc
+        theta = theta + cls.TAU * theta_dot
+        theta_dot = theta_dot + cls.TAU * theta_acc
+        x2 = jnp.stack([pos, vel, theta, theta_dot], axis=1)
+        t2 = t + 1
+        term = (jnp.abs(pos) > cls.X_LIM) | (jnp.abs(theta) > cls.THETA_LIM)
+        trunc = (t2 >= cls.MAX_STEPS) & ~term
+        return x2, t2, jnp.ones_like(pos), term, trunc
+
+
+_JAX_ENVS = {"cartpole": JaxCartPole}
+
+
+class DeviceRollout:
+    """EnvRunner-shaped rollout producer whose whole [T, B] unroll is ONE
+    jitted ``lax.scan`` over (explore_step → env.step → autoreset) on the
+    default device. Emits the same fixed-shape SampleBatch columns as
+    EnvRunner, so the learner (and its recompile guard) can't tell the
+    backends apart."""
+
+    def __init__(self, env_cls, *, num_envs: int = 1, rollout_len: int = 200,
+                 seed: int = 0, module=None, **_):
+        if isinstance(env_cls, str):
+            env_cls = _JAX_ENVS[env_cls]
+        self.env_cls = env_cls
+        self.num_envs = num_envs
+        self.rollout_len = rollout_len
+        self.params = None
+        self.params_version = -1
+        self._seed = seed
+        self._calls = 0
+        self._state = None            # (x, t) device arrays
+        self._unroll = None
+        self.module = module if module is not None else RLModule(env_cls.spec())
+        self._ep_return = np.zeros(num_envs, np.float64)
+        self._ep_len = np.zeros(num_envs, np.int64)
+        self._completed: List[Dict] = []
+
+    # same surface as EnvRunner -------------------------------------------
+    def set_weights(self, params, version: Optional[int] = None):
+        self.params = params
+        if version is not None:
+            self.params_version = int(version)
+            from ray_tpu.util import metrics
+            metrics.get_or_create(
+                metrics.Gauge, "rllib_param_version",
+                "params version in use (learner: published; "
+                "rollout: received)", tag_keys=("role",)).set(
+                    self.params_version, tags={"role": "rollout"})
+
+    def get_spec(self) -> ModuleSpec:
+        return self.module.spec
+
+    def init_params(self):
+        import jax
+        return jax.device_get(self.module.init(jax.random.PRNGKey(self._seed)))
+
+    def _ensure_jit(self):
+        if self._unroll is not None:
+            return
+        import jax
+        import jax.numpy as jnp
+        env, module, T, B = self.env_cls, self.module, self.rollout_len, \
+            self.num_envs
+
+        def unroll(params, x, t, key):
+            def body(carry, k):
+                x, t = carry
+                k_act, k_reset = jax.random.split(k)
+                obs = env.observe(x)
+                a, logp, v = module.explore_step(params, obs, k_act)
+                x2, t2, rew, term, trunc = env.step(x, t, a)
+                done = jnp.logical_or(term, trunc)
+                xr, tr = env.reset(k_reset, B)
+                x2 = jnp.where(done[:, None], xr, x2)
+                t2 = jnp.where(done, tr, t2)
+                return (x2, t2), (obs, a, rew,
+                                  done.astype(jnp.float32),
+                                  term.astype(jnp.float32), logp, v)
+
+            keys = jax.random.split(key, T)
+            (x, t), cols = jax.lax.scan(body, (x, t), keys)
+            obs, act, rew, done, term, logp, vf = cols
+            # bootstrap value of the post-rollout state; a terminated env's
+            # state is already the reset state (SAME_STEP) and its future
+            # return is 0, so mask by the final terminal flag — exactly
+            # EnvRunner's rule
+            _, boot = module.forward(params, env.observe(x))
+            boot = boot * (1.0 - term[-1])
+            return (x, t), (obs, act, rew, done, term, logp, vf, boot)
+
+        self._unroll = jax.jit(unroll)
+
+    def sample(self, params=None) -> SampleBatch:
+        import jax
+        if params is not None:
+            self.params = params
+        assert self.params is not None, "set_weights() before sample()"
+        self._ensure_jit()
+        if self._state is None:
+            self._state = self.env_cls.reset(
+                jax.random.PRNGKey(self._seed), self.num_envs)
+        key = jax.random.fold_in(
+            jax.random.PRNGKey(self._seed ^ 0x5eed), self._calls)
+        self._calls += 1
+        self._state, cols = self._unroll(self.params, self._state[0],
+                                         self._state[1], key)
+        obs, act, rew, done, term, logp, vf, boot = (
+            np.asarray(c) for c in jax.device_get(cols))
+        for tr in range(rew.shape[0]):          # episode metrics, host side
+            self._ep_return += rew[tr]
+            self._ep_len += 1
+            for i in np.nonzero(done[tr])[0]:
+                self._completed.append({"return": float(self._ep_return[i]),
+                                        "len": int(self._ep_len[i])})
+                self._ep_return[i] = 0.0
+                self._ep_len[i] = 0
+        return SampleBatch({
+            SB.OBS: obs, SB.ACTIONS: act, SB.REWARDS: rew, SB.DONES: done,
+            SB.TERMINATEDS: term, SB.LOGP: logp, SB.VF_PREDS: vf,
+            SB.BOOTSTRAP_VALUE: boot,
+        })
+
+    def pop_metrics(self) -> Dict:
+        eps, self._completed = self._completed, []
+        if not eps:
+            return {"episodes_this_iter": 0}
+        rets = [e["return"] for e in eps]
+        lens = [e["len"] for e in eps]
+        return {"episodes_this_iter": len(eps),
+                "episode_return_mean": float(np.mean(rets)),
+                "episode_return_max": float(np.max(rets)),
+                "episode_return_min": float(np.min(rets)),
+                "episode_len_mean": float(np.mean(lens))}
+
+    def num_completed_episodes(self) -> int:
+        return len(self._completed)
+
+    def close(self):
+        self._state = None
+
+
+# ---------------------------------------------------------------------------
+# rollout actor
+# ---------------------------------------------------------------------------
+
+def _rollout_backend(runner_kwargs: Dict[str, Any], jax_env):
+    if jax_env is not None:
+        return DeviceRollout(jax_env, num_envs=runner_kwargs["num_envs"],
+                             rollout_len=runner_kwargs["rollout_len"],
+                             seed=runner_kwargs.get("seed", 0))
+    return EnvRunner(**runner_kwargs)
+
+
+class RolloutActor:
+    """One saturated act+step loop deployed as a ray_tpu actor.
+
+    ``sample_traj`` is declared ``num_returns=2``: the [T, B] trajectory
+    object stays in THIS worker's store (the driver only ever holds its
+    ref and forwards it to replay) while the small info dict — version,
+    step count — travels back by value for the driver's accounting."""
+
+    def __init__(self, runner_kwargs: Dict[str, Any], index: int = 0,
+                 jax_env=None):
+        self.index = index
+        self._params = None
+        self._version = -1
+        self._impl = _rollout_backend(runner_kwargs, jax_env)
+
+    def ping(self) -> int:
+        return self.index
+
+    def get_spec(self) -> ModuleSpec:
+        return self._impl.get_spec()
+
+    def init_params(self):
+        return self._impl.init_params()
+
+    def node_info(self) -> Dict:
+        import socket
+        return {"pid": os.getpid(), "ppid": os.getppid(),
+                "hostname": socket.gethostname(), "actor": self.index}
+
+    def set_weights(self, params, version: int):
+        """Fire-and-forget broadcast target — the learner never waits on
+        the ack (except in lockstep mode)."""
+        self._params = params
+        self._version = int(version)
+        self._impl.set_weights(params, version)
+
+    @method(num_returns=2)
+    def sample_traj(self):
+        from ray_tpu.util import metrics, tracing
+        t0 = time.time()
+        batch = self._impl.sample(self._params)
+        t1 = time.time()
+        steps = int(np.asarray(batch[SB.REWARDS]).size)
+        metrics.get_or_create(
+            metrics.Counter, "rllib_env_steps",
+            "env steps collected by sebulba rollout actors").inc(steps)
+        tracing.ship_window("pipeline.act", "rllib", None, t0, t1,
+                            tid=os.getpid(),
+                            args={"actor": self.index,
+                                  "version": self._version})
+        traj = dict(batch)
+        traj["version"] = self._version
+        traj["actor"] = self.index
+        info = {"version": self._version, "steps": steps,
+                "actor": self.index, "dur_s": t1 - t0}
+        return traj, info
+
+    def pop_metrics(self) -> Dict:
+        return self._impl.pop_metrics()
+
+    def close(self):
+        self._impl.close()
+
+
+# ---------------------------------------------------------------------------
+# pipeline
+# ---------------------------------------------------------------------------
+
+class SebulbaPipeline:
+    """Driver-side orchestrator: saturate rollouts, admit refs to replay,
+    prefetch-overlap sampled batches with the jitted update, broadcast
+    versioned params fire-and-forget."""
+
+    def __init__(self, algo, config):
+        import ray_tpu
+        if not getattr(algo, "_supports_sebulba", False):
+            raise ValueError(
+                f"{type(algo).__name__} does not support the sebulba "
+                f"pipeline; it needs an off-policy-tolerant (V-trace) "
+                f"update — use IMPALA or APPO")
+        if not ray_tpu.is_initialized():
+            ray_tpu.init()
+        self.algo = algo
+        self.cfg = config
+        self.lockstep = bool(getattr(config, "sebulba_lockstep", False))
+        n = 1 if self.lockstep else max(
+            1, int(getattr(config, "sebulba_num_rollout_actors", 2)))
+        self.inflight_per_actor = 1 if self.lockstep else max(
+            1, int(getattr(config, "sebulba_inflight_rollouts", 2)))
+        self.broadcast_interval = 1 if self.lockstep else max(
+            1, int(getattr(config, "sebulba_broadcast_interval", 1)))
+        self.sample_count = 1 if self.lockstep else max(
+            1, int(getattr(config, "sebulba_sample_batch_count", 1)))
+        mode = "fifo" if self.lockstep else str(
+            getattr(config, "sebulba_replay_mode", "uniform"))
+        self.min_replay = max(1, int(getattr(config, "sebulba_min_replay", 1)))
+        self.max_staleness = getattr(config, "sebulba_max_staleness", None)
+        replay_seed = getattr(config, "sebulba_replay_seed", None)
+        if replay_seed is None:
+            replay_seed = config.seed
+
+        decorator: Dict[str, Any] = {"num_cpus": 1}
+        if getattr(config, "env_runner_resources", None):
+            decorator["resources"] = dict(config.env_runner_resources)
+        if getattr(config, "env_runner_scheduling_strategy", None) is not None:
+            decorator["scheduling_strategy"] = \
+                config.env_runner_scheduling_strategy
+        RemoteRollout = ray_tpu.remote(**decorator)(RolloutActor)
+        kw = algo._make_runner_kwargs()
+        jax_env = getattr(config, "sebulba_jax_env", None)
+        self.actors = [
+            RemoteRollout.remote({**kw, "seed": config.seed + i},
+                                 index=i, jax_env=jax_env)
+            for i in range(n)]
+        RemoteReplay = ray_tpu.remote(num_cpus=1)(ReplayActor)
+        self.replay = RemoteReplay.remote(
+            int(getattr(config, "sebulba_replay_capacity", 64)),
+            seed=int(replay_seed), mode=mode)
+        ray_tpu.get([a.ping.remote() for a in self.actors]
+                    + [self.replay.ping.remote()])
+
+        self.version = 0            # params version currently published
+        self.updates = 0
+        self._broadcasts = 0
+        self._broadcasts_async = 0  # fire-and-forget (no ack awaited)
+        self._env_steps_total = 0
+        self._replay_admitted = 0
+        self._stale_dropped = 0
+        self._gap_counts: Dict[int, int] = {}   # off-policy gap → updates
+        self._last_learn: Dict[str, float] = {}
+        self._inflight: Dict[str, tuple] = {}   # info-ref id → (iref, tref, i)
+        self._pending_sample = None             # in-flight sample_refs ref
+        self._fetching = None                   # (future, versions)
+        self._pool = ThreadPoolExecutor(1, thread_name_prefix="sebulba-fetch")
+        self._closed = False
+
+        # actors are useless until they hold v0 weights — this one
+        # broadcast blocks; steady-state broadcasts are fire-and-forget
+        self._broadcast(block=True)
+        if not self.lockstep:
+            for i in range(len(self.actors)):
+                for _ in range(self.inflight_per_actor):
+                    self._submit(i)
+
+    # -- rollout side -------------------------------------------------------
+    def _submit(self, idx: int):
+        tref, iref = self.actors[idx].sample_traj.remote()
+        self._inflight[iref.id] = (iref, tref, idx)
+
+    def _reap(self, block: bool) -> int:
+        """Admit finished rollouts to replay (refs only — the trajectory
+        object never leaves the producing node) and resubmit. Returns env
+        steps admitted."""
+        import ray_tpu
+        if not self._inflight:
+            return 0
+        irefs = [e[0] for e in self._inflight.values()]
+        ready, _ = ray_tpu.wait(irefs, num_returns=len(irefs), timeout=0.0)
+        if not ready and block:
+            ready, _ = ray_tpu.wait(irefs, num_returns=1, timeout=0.05)
+        steps = 0
+        from ray_tpu.util import metrics
+        for iref in ready:
+            iref, tref, idx = self._inflight.pop(iref.id)
+            info = ray_tpu.get(iref)
+            # wrapped in a list → arrives at the replay actor as a REF
+            self.replay.add_refs.remote([tref], [int(info["version"])])
+            del tref
+            self._replay_admitted += 1
+            steps += int(info["steps"])
+            self._submit(idx)
+        if steps:
+            metrics.get_or_create(
+                metrics.Counter, "rllib_env_steps",
+                "env steps collected by sebulba rollout actors").inc(steps)
+        self._env_steps_total += steps
+        return steps
+
+    # -- learner side -------------------------------------------------------
+    def _request_sample(self):
+        if self._pending_sample is None \
+                and self._replay_admitted >= self.min_replay:
+            self._pending_sample = self.replay.sample_refs.remote(
+                self.sample_count)
+
+    def _start_fetch(self, block: bool) -> bool:
+        """Pending sample resolved → hand the refs to the fetch thread so
+        trajectory bytes stream in while the driver thread runs the jitted
+        update (the prefetch overlap)."""
+        import ray_tpu
+        if self._fetching is not None or self._pending_sample is None:
+            return False
+        if not block:
+            ready, _ = ray_tpu.wait([self._pending_sample], num_returns=1,
+                                    timeout=0.0)
+            if not ready:
+                return False
+        pairs = ray_tpu.get(self._pending_sample)
+        self._pending_sample = None
+        if not pairs:
+            return False            # replay dry (fifo) — retry after admits
+        refs = [p[0] for p in pairs]
+        versions = [int(p[1]) for p in pairs]
+        self._fetching = (self._pool.submit(ray_tpu.get, refs), versions)
+        return True
+
+    def _learn_turn(self, block: bool = False) -> bool:
+        """Advance the learner state machine; True if an update ran."""
+        while True:
+            if self._fetching is not None:
+                fut, versions = self._fetching
+                if not fut.done() and not block:
+                    return False
+                trajs = fut.result()
+                self._fetching = None
+                # queue the NEXT sample before updating, so its fetch
+                # overlaps this update
+                self._request_sample()
+                self._start_fetch(block=False)
+                self._apply_update(trajs, versions)
+                return True
+            self._request_sample()
+            if self._pending_sample is None:
+                return False        # replay below min_replay — keep reaping
+            if not self._start_fetch(block=block):
+                if not block:
+                    return False
+                if self._pending_sample is None and self._fetching is None:
+                    return False    # sampled empty — caller reaps more
+
+    def _apply_update(self, trajs: List[Dict], versions: List[int]):
+        from ray_tpu.util import metrics, tracing
+        gap = self.version - min(versions)
+        self._gap_counts[gap] = self._gap_counts.get(gap, 0) + 1
+        metrics.get_or_create(
+            metrics.Histogram, "rllib_offpolicy_gap",
+            "learner_version - trajectory_version at update time (the "
+            "off-policy gap V-trace corrects)",
+            boundaries=(0.5, 1.5, 2.5, 4.5, 8.5, 16.5)).observe(float(gap))
+        if self.max_staleness is not None and gap > self.max_staleness:
+            self._stale_dropped += len(trajs)
+            metrics.get_or_create(
+                metrics.Counter, "rllib_stale_dropped",
+                "replay samples dropped for exceeding "
+                "sebulba_max_staleness").inc(len(trajs))
+            return
+        cols = [SampleBatch({k: v for k, v in t.items()
+                             if k not in ("version", "actor")})
+                for t in trajs]
+        batch = cols[0] if len(cols) == 1 else SampleBatch.concat(cols, axis=1)
+        t0 = time.time()
+        self._last_learn = self.algo._sebulba_update(batch)
+        t1 = time.time()
+        self.updates += 1
+        self.version += 1
+        tracing.ship_window("pipeline.learn", "rllib", None, t0, t1,
+                            tid=os.getpid(),
+                            args={"version": self.version, "gap": gap})
+        metrics.get_or_create(
+            metrics.Counter, "rllib_learner_steps",
+            "sebulba learner updates").inc()
+        metrics.get_or_create(
+            metrics.Gauge, "rllib_param_version",
+            "params version in use (learner: published; rollout: received)",
+            tag_keys=("role",)).set(self.version, tags={"role": "learner"})
+        if self.updates % self.broadcast_interval == 0:
+            self._broadcast(block=self.lockstep)
+
+    def _broadcast(self, block: bool = False):
+        import ray_tpu
+        from ray_tpu.util import metrics
+        wref = ray_tpu.put(self.algo.get_weights())
+        acks = [a.set_weights.remote(wref, self.version) for a in self.actors]
+        del wref
+        self._broadcasts += 1
+        if not block:
+            self._broadcasts_async += 1
+        metrics.get_or_create(
+            metrics.Counter, "rllib_broadcasts",
+            "sebulba param broadcasts (fire-and-forget except lockstep)",
+            tag_keys=("kind",)).inc(
+                1, tags={"kind": "blocking" if block else "async"})
+        if block:
+            ray_tpu.get(acks)
+
+    # -- iteration ----------------------------------------------------------
+    def training_step(self) -> Dict[str, Any]:
+        import ray_tpu
+        target = self.cfg.train_batch_size
+        steps = self._step_lockstep(target) if self.lockstep \
+            else self._step_async(target)
+        self.algo._env_steps_iter += steps
+        rms = ray_tpu.get([a.pop_metrics.remote() for a in self.actors])
+        result = _merge_runner_metrics(rms)
+        result["num_env_steps_sampled_this_iter"] = steps
+        result["learner"] = dict(self._last_learn)
+        result["sebulba"] = self.stats(remote=False)
+        return result
+
+    def _step_async(self, target: int) -> int:
+        steps = 0
+        updates_before = self.updates
+        while steps < target:
+            steps += self._reap(block=True)
+            self._learn_turn(block=False)
+        # an iteration must learn at least once (replay has ≥1 admission
+        # by now, so a blocking turn can only stall on a dry fifo — reap
+        # keeps feeding it)
+        while self.updates == updates_before:
+            if not self._learn_turn(block=True):
+                self._reap(block=True)
+        return steps
+
+    def _step_lockstep(self, target: int) -> int:
+        """Strictly sequential schedule: sample → admit → replay(fifo) →
+        fetch → update → blocking broadcast. Reproduces the synchronous
+        IMPALA iteration exactly (the parity anchor)."""
+        import ray_tpu
+        steps = 0
+        from ray_tpu.util import metrics
+        while steps < target:
+            tref, iref = self.actors[0].sample_traj.remote()
+            info = ray_tpu.get(iref)
+            self.replay.add_refs.remote([tref], [int(info["version"])])
+            del tref
+            self._replay_admitted += 1
+            steps += int(info["steps"])
+            self._env_steps_total += int(info["steps"])
+            metrics.get_or_create(
+                metrics.Counter, "rllib_env_steps",
+                "env steps collected by sebulba rollout actors").inc(
+                    int(info["steps"]))
+            pairs = ray_tpu.get(self.replay.sample_refs.remote(1))
+            trajs = ray_tpu.get([p[0] for p in pairs])
+            self._apply_update(trajs, [int(p[1]) for p in pairs])
+        return steps
+
+    # -- introspection ------------------------------------------------------
+    def stats(self, remote: bool = True) -> Dict[str, Any]:
+        from ray_tpu.util import metrics
+        s: Dict[str, Any] = {
+            "version": self.version, "updates": self.updates,
+            "broadcasts": self._broadcasts,
+            "broadcasts_async": self._broadcasts_async,
+            "env_steps": self._env_steps_total,
+            "replay_admitted": self._replay_admitted,
+            "stale_dropped": self._stale_dropped,
+            "gap_counts": dict(self._gap_counts),
+            "num_rollout_actors": len(self.actors),
+            "inflight": len(self._inflight),
+            "lockstep": self.lockstep,
+            "jit_cache_size": self.algo.learner.jit_cache_size(),
+            "counters": metrics.rllib_sebulba_counters(),
+            "offpolicy_gap": metrics.rllib_offpolicy_gap_summary(),
+        }
+        if remote and self.replay is not None:
+            import ray_tpu
+            s["replay"] = ray_tpu.get(self.replay.stats.remote())
+        return s
+
+    # -- teardown -----------------------------------------------------------
+    def shutdown(self):
+        """Leak-free stop: drain in-flight work, await the replay actor's
+        clear() (its slot borrows must drop BEFORE the handle does), then
+        release every handle."""
+        if self._closed:
+            return
+        self._closed = True
+        import ray_tpu
+        try:
+            if self._inflight:
+                irefs = [e[0] for e in self._inflight.values()]
+                ray_tpu.wait(irefs, num_returns=len(irefs), timeout=30.0)
+        except Exception:  # noqa: BLE001 - teardown best-effort
+            pass
+        self._inflight.clear()
+        if self._fetching is not None:
+            try:
+                self._fetching[0].result(timeout=30)
+            except Exception:  # noqa: BLE001
+                pass
+            self._fetching = None
+        if self._pending_sample is not None:
+            try:
+                ray_tpu.get(self._pending_sample)
+            except Exception:  # noqa: BLE001
+                pass
+            self._pending_sample = None
+        self._pool.shutdown(wait=True)
+        try:
+            if self.replay is not None:
+                ray_tpu.get(self.replay.clear.remote())
+        except Exception:  # noqa: BLE001
+            pass
+        self.replay = None
+        self.actors = []

@@ -416,6 +416,54 @@ def bubble_stats(events: List[Dict[str, Any]], phase: str = "exec",
                                    if total_span > 0 else 0.0}}
 
 
+def overlap_stats(events: List[Dict[str, Any]], name_a: str,
+                  name_b: str) -> Dict[str, Any]:
+    """Wall-clock overlap between two span families in a Chrome-trace
+    event list (the output of ``api.timeline()`` — ts/dur in µs).
+
+    Windows whose ``name`` starts with ``name_a`` (resp. ``name_b``) are
+    merged — across ALL pids/tids, since the two families usually live in
+    different processes (e.g. ``pipeline.act`` in rollout workers vs
+    ``pipeline.learn`` in the driver) — and the intersection of the two
+    merged interval sets is measured:
+
+        overlap_fraction = overlap_s / min(busy_a, busy_b)
+
+    A decoupled pipeline shows fraction near 1 (the smaller family runs
+    almost entirely under the bigger one); a synchronous loop shows ~0.
+    Used by ``rllib_bench`` to assert rollout/learn overlap."""
+    wins: Dict[str, List[Tuple[float, float]]] = {"a": [], "b": []}
+    for e in events:
+        if e.get("ph") not in (None, "X") or "ts" not in e:
+            continue
+        name = str(e.get("name", ""))
+        t0 = e["ts"] / 1e6
+        w = (t0, t0 + e.get("dur", 0.0) / 1e6)
+        if name.startswith(name_a):
+            wins["a"].append(w)
+        elif name.startswith(name_b):
+            wins["b"].append(w)
+    a = _merge_windows(wins["a"])
+    b = _merge_windows(wins["b"])
+    busy_a = sum(t1 - t0 for t0, t1 in a)
+    busy_b = sum(t1 - t0 for t0, t1 in b)
+    overlap = 0.0
+    i = j = 0
+    while i < len(a) and j < len(b):
+        lo = max(a[i][0], b[j][0])
+        hi = min(a[i][1], b[j][1])
+        if hi > lo:
+            overlap += hi - lo
+        if a[i][1] <= b[j][1]:
+            i += 1
+        else:
+            j += 1
+    floor = min(busy_a, busy_b)
+    return {"windows_a": len(wins["a"]), "windows_b": len(wins["b"]),
+            "busy_a_s": busy_a, "busy_b_s": busy_b, "overlap_s": overlap,
+            "overlap_fraction": overlap / floor if floor > 0 else 0.0}
+
+
 def summary() -> Dict[str, Any]:
     """Cheap per-process health snapshot for bench records."""
     with _lock:

@@ -1,0 +1,292 @@
+"""Sebulba pipeline tests: ref-based replay, device-resident rollouts,
+lockstep parity with sync IMPALA, off-policy gap ≥ 1 under async mode,
+recompile guard, deterministic sampling, leak-free shutdown."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from ray_tpu.rllib import (IMPALAConfig, PPOConfig, ReplayActor,
+                           DeviceRollout, JaxCartPole)
+from ray_tpu.rllib.sebulba import _JAX_ENVS
+
+
+# ------------------------------------------------------------- replay actor
+def test_replay_actor_ring_fifo_and_clear():
+    buf = ReplayActor(capacity=3, seed=0, mode="fifo")
+    assert buf.add_refs(["r0", "r1"], [0, 0]) == 2
+    assert buf.size() == 2
+    # fifo hands each slot out exactly once, oldest first
+    assert buf.sample_refs(1) == [("r0", 0)]
+    assert buf.sample_refs(5) == [("r1", 0)]
+    assert buf.sample_refs(1) == []          # queue dry
+    buf.add_refs(["r2", "r3", "r4"], 1)      # scalar version broadcast
+    # capacity 3: r0 and r1 ring-evicted, cursor rebased past them
+    s = buf.stats()
+    assert s["evicted"] == 2 and s["size"] == 3
+    assert buf.sample_refs(2) == [("r2", 1), ("r3", 1)]
+    assert buf.clear() == 3
+    assert buf.size() == 0 and buf.sample_refs(1) == []
+
+
+def test_replay_actor_deterministic_sampling_pinned():
+    """Satellite: sampling is seeded from config — same seed, same index
+    sequence, run after run. Pinned against the numpy PCG64 stream."""
+    buf = ReplayActor(capacity=8, seed=123, mode="uniform")
+    buf.add_refs([f"r{i}" for i in range(8)], list(range(8)))
+    assert buf._sample_indices(4) == [0, 5, 4, 0]
+    assert buf._sample_indices(4) == [7, 1, 2, 1]
+    # identical seed ⇒ identical stream
+    buf2 = ReplayActor(capacity=8, seed=123, mode="uniform")
+    buf2.add_refs([f"r{i}" for i in range(8)], 0)
+    assert buf2._sample_indices(8) == [0, 5, 4, 0, 7, 1, 2, 1]
+
+
+def test_replay_actor_rejects_unknown_mode():
+    with pytest.raises(ValueError):
+        ReplayActor(capacity=4, mode="priority")
+
+
+# --------------------------------------------------------- device-resident
+def test_jax_cartpole_matches_gym_physics():
+    import gymnasium as gym
+    import jax.numpy as jnp
+    env = gym.make("CartPole-v1")
+    env.reset(seed=0)
+    state = np.array([0.01, -0.02, 0.03, 0.04], np.float32)
+    for action in (0, 1):
+        env.unwrapped.state = state.copy()
+        obs_g, rew_g, term_g, trunc_g, _ = env.step(action)
+        x = jnp.asarray(state[None])
+        t = jnp.zeros((1,), jnp.int32)
+        x2, t2, rew_j, term_j, trunc_j = JaxCartPole.step(
+            x, t, jnp.asarray([action]))
+        np.testing.assert_allclose(np.asarray(x2[0]), obs_g, atol=1e-5)
+        assert float(rew_j[0]) == rew_g == 1.0
+        assert bool(term_j[0]) == term_g
+    env.close()
+
+
+def test_device_rollout_fixed_shapes_and_autoreset():
+    roll = DeviceRollout("cartpole", num_envs=3, rollout_len=16, seed=5)
+    assert "cartpole" in _JAX_ENVS
+    roll.set_weights(roll.init_params(), version=0)
+    total_done = 0
+    for _ in range(6):   # random policy episodes end well inside ~96 steps
+        b = roll.sample()
+        assert b["obs"].shape == (16, 3, 4)
+        assert b["actions"].shape == (16, 3)
+        assert b["bootstrap_value"].shape == (3,)
+        # bootstrap masked by the final terminal flag (EnvRunner's rule)
+        term_last = np.asarray(b["terminateds"])[-1]
+        boot = np.asarray(b["bootstrap_value"])
+        assert np.all(boot[term_last == 1.0] == 0.0)
+        total_done += int(np.asarray(b["dones"]).sum())
+    assert total_done > 0
+    m = roll.pop_metrics()
+    assert m["episodes_this_iter"] == total_done
+    assert roll.params_version == 0
+
+
+def test_device_rollout_deterministic_given_seed():
+    params = DeviceRollout("cartpole", num_envs=2, rollout_len=8,
+                           seed=9).init_params()
+    outs = []
+    for _ in range(2):
+        roll = DeviceRollout("cartpole", num_envs=2, rollout_len=8, seed=9)
+        roll.set_weights(params, version=0)
+        outs.append(roll.sample())
+    np.testing.assert_array_equal(outs[0]["obs"], outs[1]["obs"])
+    np.testing.assert_array_equal(outs[0]["actions"], outs[1]["actions"])
+
+
+# ---------------------------------------------------------------- config api
+def test_config_sebulba_builder():
+    cfg = (IMPALAConfig()
+           .sebulba(num_rollout_actors=3, inflight_rollouts=4,
+                    replay_capacity=32, replay_mode="fifo",
+                    broadcast_interval=2, max_staleness=8,
+                    replay_seed=77, jax_env="cartpole"))
+    assert cfg.sebulba_enabled
+    assert cfg.sebulba_num_rollout_actors == 3
+    assert cfg.sebulba_inflight_rollouts == 4
+    assert cfg.sebulba_replay_capacity == 32
+    assert cfg.sebulba_replay_mode == "fifo"
+    assert cfg.sebulba_broadcast_interval == 2
+    assert cfg.sebulba_max_staleness == 8
+    assert cfg.sebulba_replay_seed == 77
+    assert cfg.sebulba_jax_env == "cartpole"
+    # default off
+    assert not IMPALAConfig().sebulba_enabled
+
+
+def test_sebulba_requires_vtrace_algo(ray_session):
+    cfg = (PPOConfig().environment("CartPole-v1")
+           .env_runners(num_envs_per_env_runner=1, rollout_fragment_length=4)
+           .training(train_batch_size=8, minibatch_size=4)
+           .sebulba())
+    with pytest.raises(ValueError, match="sebulba"):
+        cfg.build()
+
+
+# ------------------------------------------------------------ observability
+def test_tracing_overlap_stats_math():
+    from ray_tpu.util import tracing
+
+    def ev(name, t0, dur):
+        return {"name": name, "ph": "X", "ts": t0 * 1e6, "dur": dur * 1e6}
+
+    events = [ev("pipeline.act", 0.0, 1.0),     # [0, 1]
+              ev("pipeline.act", 2.0, 1.0),     # [2, 3]
+              ev("pipeline.learn", 0.5, 1.0),   # [0.5, 1.5] → 0.5s overlap
+              ev("pipeline.learn", 2.5, 0.25)]  # [2.5, 2.75] → 0.25s overlap
+    s = tracing.overlap_stats(events, "pipeline.act", "pipeline.learn")
+    assert s["windows_a"] == 2 and s["windows_b"] == 2
+    assert abs(s["busy_a_s"] - 2.0) < 1e-9
+    assert abs(s["busy_b_s"] - 1.25) < 1e-9
+    assert abs(s["overlap_s"] - 0.75) < 1e-9
+    assert abs(s["overlap_fraction"] - 0.6) < 1e-9   # 0.75 / min(2, 1.25)
+    # disjoint families → zero
+    s2 = tracing.overlap_stats(events[:1] + events[3:],
+                               "pipeline.act", "pipeline.learn")
+    assert s2["overlap_s"] == 0.0
+
+
+def test_rllib_sebulba_counters_surface():
+    from ray_tpu.util import metrics
+    before = metrics.rllib_sebulba_counters()
+    metrics.get_or_create(metrics.Counter, "rllib_env_steps", "t").inc(40)
+    metrics.get_or_create(metrics.Counter, "rllib_learner_steps", "t").inc(2)
+    metrics.get_or_create(
+        metrics.Gauge, "rllib_param_version", "t",
+        tag_keys=("role",)).set(11, tags={"role": "learner"})
+    after = metrics.rllib_sebulba_counters()
+    assert after["env_steps"] - before["env_steps"] == 40
+    assert after["learner_steps"] - before["learner_steps"] == 2
+    assert after["param_version"] >= 11
+    # the histogram read surface tolerates the metric not existing yet
+    assert metrics.rllib_offpolicy_gap_summary() is None \
+        or "count" in metrics.rllib_offpolicy_gap_summary()
+
+
+# ------------------------------------------------------------- end to end
+def _impala_cfg(seed=3, **sebulba_kwargs):
+    cfg = (IMPALAConfig()
+           .environment("CartPole-v1")
+           .env_runners(num_env_runners=0, num_envs_per_env_runner=2,
+                        rollout_fragment_length=8)
+           .training(train_batch_size=16)   # == T*B → 1 update per iter
+           .debugging(seed=seed))
+    if sebulba_kwargs:
+        cfg = cfg.sebulba(**sebulba_kwargs)
+    return cfg
+
+
+def _leaked_big(min_bytes=1 << 16):
+    from ray_tpu._private import state
+    from ray_tpu._private.health import LeakDetector
+    ctl = state.global_client().controller
+    det = LeakDetector(age_s=0.0, clock=lambda: time.time() + 3600.0)
+    return [f for f in det.scan(ctl.objects)
+            if (f.get("size") or 0) >= min_bytes]
+
+
+def test_sebulba_lockstep_parity_with_sync_impala(ray_session):
+    """Gap-0 anchor: lockstep sebulba (1 actor, fifo replay, blocking
+    broadcast) replays the synchronous schedule exactly — identical
+    params after N iterations."""
+    import jax
+
+    sync = (IMPALAConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=2,
+                         rollout_fragment_length=8)
+            .training(train_batch_size=16)
+            .debugging(seed=3)).build()
+    for _ in range(2):
+        sync.train()
+    w_sync = sync.get_weights()
+    sync.stop()
+
+    seb = _impala_cfg(seed=3, lockstep=True).build()
+    for _ in range(2):
+        r = seb.train()
+    s = r["sebulba"]
+    assert s["lockstep"] and s["updates"] == 2
+    assert s["gap_counts"] == {0: 2}          # exact off-policy gap 0
+    assert s["jit_cache_size"] == 1           # recompile guard
+    w_seb = seb.get_weights()
+    seb.stop()
+    time.sleep(0.5)
+
+    for a, b in zip(jax.tree_util.tree_leaves(w_sync),
+                    jax.tree_util.tree_leaves(w_seb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+    assert not _leaked_big()                  # replay.clear() ran
+
+
+def test_sebulba_async_offpolicy_gap_and_guard(ray_session):
+    """Async mode with 2 in-flight rollouts per actor: V-trace must see
+    trajectories with gap ≥ 1, the jitted update must compile exactly
+    once, and shutdown must leave no pinned trajectory objects."""
+    algo = _impala_cfg(seed=7, num_rollout_actors=2, inflight_rollouts=2,
+                       replay_capacity=8, jax_env="cartpole").build()
+    cfg = algo.config
+    assert cfg.sebulba_jax_env == "cartpole"
+    stats = None
+    for _ in range(4):
+        stats = algo.train()["sebulba"]
+        if any(g >= 1 for g in stats["gap_counts"]):
+            break
+    assert stats["updates"] >= 1
+    assert any(g >= 1 for g in stats["gap_counts"]), stats["gap_counts"]
+    assert stats["jit_cache_size"] == 1, "jitted update recompiled"
+    assert stats["counters"]["broadcasts"] >= 1
+    replay = ray_session.get(algo._sebulba.replay.stats.remote())
+    assert replay["admitted"] > 0 and replay["mode"] == "uniform"
+    algo.stop()
+    time.sleep(0.5)
+    assert not _leaked_big()
+
+
+def test_rllib_bench_sebulba_smoke_gate():
+    """rllib_bench --smoke is the tier-1 hook for the whole pipeline:
+    nonzero fire-and-forget broadcasts, pipeline.act/pipeline.learn span
+    overlap on the head timeline, lockstep parity, leak-free shutdown."""
+    bench = os.path.join(os.path.dirname(__file__), os.pardir,
+                         "benchmarks", "rllib_bench.py")
+    proc = subprocess.run(
+        [sys.executable, bench, "--smoke"], capture_output=True, text=True,
+        timeout=420, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert proc.returncode == 0, (proc.stdout[-2000:], proc.stderr[-2000:])
+    rec = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert rec["smoke"] == "ok"
+    assert rec["parity"]["ok"] is True
+    assert rec["broadcasts_async"] > 0
+    assert rec["overlap_s"] > 0
+    assert rec["jit_cache_size"] == 1
+    assert rec["leaked_big"] == 0
+
+
+@pytest.mark.slow
+def test_sebulba_appo_vtrace_path(ray_session):
+    """APPO rides the same pipeline: driver-side V-trace targets under
+    current params, then the clipped-surrogate update."""
+    from ray_tpu.rllib import APPOConfig
+    algo = (APPOConfig()
+            .environment("CartPole-v1")
+            .env_runners(num_env_runners=0, num_envs_per_env_runner=2,
+                         rollout_fragment_length=8)
+            .training(train_batch_size=16, minibatch_size=16, num_epochs=1)
+            .sebulba(num_rollout_actors=1, inflight_rollouts=2)
+            .debugging(seed=11)).build()
+    r = algo.train()
+    assert r["sebulba"]["updates"] >= 1
+    assert "total_loss" in r["learner"]
+    algo.stop()
