@@ -145,13 +145,25 @@ def run_mode(sync: bool, n: int, fanout_m: int, reps: int = 1,
             # ~5 ms burst is a multi-hundred-µs stall that lands entirely
             # on p99 — it belongs to the bench process, not the submit path
             gc.disable()
-            t0 = time.perf_counter()
+            # Latency is SAMPLED (every 8th call): at ~5 µs/submit the two
+            # perf_counter() reads + append were ~0.3 µs of the timed
+            # window — bench overhead charged to submit_tps. The stride
+            # keeps percentiles honest while the throughput number reflects
+            # the submit path, not the measurement.
+            remote = _noop.remote
             refs = []
+            refs_append = refs.append
+            lat_append = lat.append
+            perf = time.perf_counter
+            t0 = perf()
             for i in range(n):
-                s = time.perf_counter()
-                refs.append(_noop.remote(i))
-                lat.append(time.perf_counter() - s)
-            t_submit = time.perf_counter() - t0
+                if i & 7:
+                    refs_append(remote(i))
+                else:
+                    s = perf()
+                    refs_append(remote(i))
+                    lat_append(perf() - s)
+            t_submit = perf() - t0
             gc.enable()
             submit_rt = metrics.control_roundtrips_total() - rt0
             vals = ray_tpu.get(refs)
@@ -182,6 +194,84 @@ def run_mode(sync: bool, n: int, fanout_m: int, reps: int = 1,
         }
     finally:
         ray_tpu.shutdown()
+
+
+# ------------------------------------------------------- ownership model
+
+def ownership_chain(depth: int, reps: int = 3):
+    """ISSUE 17 acceptance probe: a depth-k dependent task chain submitted
+    and get() by the driver must cost ZERO blocking controller round trips —
+    every return object is client-owned (spec.owner_id = "driver"), its
+    descriptor is pushed back over the in-process sink, and get() serves
+    from the local ownership table (control_local_gets_total counts the
+    serves). For contrast the same chain runs with RAY_TPU_OWNERSHIP=0:
+    head-owned descriptors force get() through a blocking driver_call."""
+    out = {"depth": depth}
+    for owned in (True, False):
+        os.environ["RAY_TPU_SYNC_SUBMIT"] = "0"
+        os.environ["RAY_TPU_OWNERSHIP"] = "1" if owned else "0"
+        import ray_tpu
+        from ray_tpu.util import metrics
+        ray_tpu.init(num_cpus=NUM_CPUS)
+        try:
+            @ray_tpu.remote
+            def _inc(x):
+                return x + 1
+
+            ray_tpu.get(_inc.remote(0))  # warmup: spawn + prime caches
+            best = None
+            for _ in range(max(reps, 1)):
+                time.sleep(SETTLE_S)
+                rt0 = metrics.control_roundtrips_total()
+                lg0 = metrics.control_local_gets_total()
+                t0 = time.perf_counter()
+                ref = _inc.remote(0)
+                for _ in range(depth - 1):
+                    ref = _inc.remote(ref)
+                val = ray_tpu.get(ref)
+                dt = time.perf_counter() - t0
+                rec = {
+                    "chain_ms": round(dt * 1e3, 2),
+                    "roundtrips": metrics.control_roundtrips_total() - rt0,
+                    "local_gets": metrics.control_local_gets_total() - lg0,
+                }
+                assert val == depth, f"chain returned {val}, want {depth}"
+                if best is None or rec["chain_ms"] < best["chain_ms"]:
+                    best = rec
+            out["owned" if owned else "head_owned"] = best
+        finally:
+            ray_tpu.shutdown()
+            os.environ.pop("RAY_TPU_OWNERSHIP", None)
+    assert out["owned"]["roundtrips"] == 0, (
+        f"ownership chain cost {out['owned']['roundtrips']} blocking round "
+        f"trips (client-owned objects must cost zero)")
+    return out
+
+
+def sched_compare(n: int):
+    """Native C++ schedule pass (sq_schedule, the ISSUE 17 tentpole) vs the
+    Python oracle (RAY_TPU_NATIVE_SCHED=0): same build, same workload —
+    the delta is the batched native feasibility/match/claim pass."""
+    prev = os.environ.get("RAY_TPU_NATIVE_SCHED")
+    try:
+        os.environ["RAY_TPU_NATIVE_SCHED"] = "1"
+        native = run_mode(sync=False, n=n, fanout_m=4, reps=3)
+        os.environ["RAY_TPU_NATIVE_SCHED"] = "0"
+        python = run_mode(sync=False, n=n, fanout_m=4, reps=3)
+    finally:
+        if prev is None:
+            os.environ.pop("RAY_TPU_NATIVE_SCHED", None)
+        else:
+            os.environ["RAY_TPU_NATIVE_SCHED"] = prev
+    return {
+        "n": n,
+        "native": {k: native[k] for k in
+                   ("submit_tps", "e2e_tps", "submit_p50_us")},
+        "python": {k: python[k] for k in
+                   ("submit_tps", "e2e_tps", "submit_p50_us")},
+        "e2e_speedup": round(native["e2e_tps"] /
+                             max(python["e2e_tps"], 1e-9), 2),
+    }
 
 
 # ------------------------------------------------- multi-driver saturation
@@ -263,7 +353,7 @@ def _wait_for(pred, timeout, msg):
     raise TimeoutError("timed out waiting for " + msg)
 
 
-def _cluster_e2e(num_agents: int, n: int):
+def _cluster_e2e(num_agents: int, n: int, reps: int = 12):
     """Head + `num_agents` loopback node agents; the workload is pinned to
     the head so compute stays constant — what varies is only the
     control-plane load the extra nodes add (heartbeats, holds-object
@@ -290,8 +380,15 @@ def _cluster_e2e(num_agents: int, n: int):
             return i
 
         ray_tpu.get([_noop.remote(i) for i in range(8)])
-        best_submit, best_e2e = 0.0, 0.0
-        for _ in range(3):
+        submit_tps, best_e2e = [], 0.0
+        # Per-rep samples: on a small host the submit window (~1 ms) is
+        # shorter than an OS scheduling quantum, so any single rep is a
+        # lottery on whether the controller loop / node heartbeats preempt
+        # the submitting thread mid-window. The caller aggregates samples
+        # across interleaved cycles — the MEDIAN rep is the flatness
+        # signal (a single lucky window in one config must not swing the
+        # ratio), the max is reported as the peak.
+        for _ in range(reps):
             time.sleep(SETTLE_S)
             t0 = time.perf_counter()
             refs = [_noop.remote(i) for i in range(n)]
@@ -299,10 +396,10 @@ def _cluster_e2e(num_agents: int, n: int):
             vals = ray_tpu.get(refs)
             t_e2e = time.perf_counter() - t0
             assert vals == list(range(n)), "wrong results under cluster"
-            best_submit = max(best_submit, n / t_submit)
+            submit_tps.append(n / t_submit)
             best_e2e = max(best_e2e, n / t_e2e)
         return {"nodes": num_agents + 1, "n": n,
-                "submit_tps": round(best_submit, 1),
+                "submit_tps_reps": submit_tps,
                 "e2e_tps": round(best_e2e, 1)}
     finally:
         for p in procs:
@@ -316,18 +413,39 @@ def _cluster_e2e(num_agents: int, n: int):
 
 
 def node_flatness(n: int):
-    """Acceptance probe: submit tasks/sec with 1 vs 4 attached loopback
-    nodes. A sharded directory + codec'd heartbeat plane should hold the
-    submit rate flat (±20%); a global-lock control plane decays as nodes
-    multiply. e2e tps rides along but is NOT the flatness signal — on a
-    small host it measures CPU contention from the extra agent processes,
-    not the control plane."""
-    one = _cluster_e2e(1, n)
-    four = _cluster_e2e(4, n)
-    return {"runs": [one, four],
-            "tps_ratio_4v1": round(four["submit_tps"] /
+    """Acceptance probe (ISSUE 17): submit tasks/sec with 1 vs 8 attached
+    loopback node agents. A sharded directory + codec'd heartbeat plane
+    should hold the submit rate flat — `flatness_8v1` (1-agent tps over
+    8-agent tps) must stay ≤ 1.05; a global-lock control plane decays as
+    nodes multiply. e2e tps rides along but is NOT the flatness signal —
+    on a small host it measures CPU contention from the extra agent
+    processes, not the control plane.
+
+    The two configs run in ALTERNATING cycles (1, 8, 1, 8, ...), pooling
+    per-rep samples per config: shared-host noise (steal time, neighbor
+    load) drifts over tens of seconds, so back-to-back blocks would hand
+    one config a systematically slow phase and swing the ratio either
+    way run-to-run. Flatness compares the MEDIAN rep per config (robust
+    to both preempted and once-in-a-run lucky windows); the max rides
+    along as submit_tps_peak."""
+    import statistics
+    one = {"nodes": 2, "n": n, "e2e_tps": 0.0, "reps": []}
+    eight = {"nodes": 9, "n": n, "e2e_tps": 0.0, "reps": []}
+    for _ in range(3):
+        for agents, agg in ((1, one), (8, eight)):
+            cyc = _cluster_e2e(agents, n, reps=4)
+            agg["reps"].extend(cyc["submit_tps_reps"])
+            agg["e2e_tps"] = max(agg["e2e_tps"], cyc["e2e_tps"])
+    for agg in (one, eight):
+        reps = agg.pop("reps")
+        agg["submit_tps"] = round(statistics.median(reps), 1)
+        agg["submit_tps_peak"] = round(max(reps), 1)
+    return {"runs": [one, eight],
+            "tps_ratio_8v1": round(eight["submit_tps"] /
                                    max(one["submit_tps"], 1e-9), 3),
-            "e2e_ratio_4v1": round(four["e2e_tps"] /
+            "flatness_8v1": round(one["submit_tps"] /
+                                  max(eight["submit_tps"], 1e-9), 3),
+            "e2e_ratio_8v1": round(eight["e2e_tps"] /
                                    max(one["e2e_tps"], 1e-9), 3)}
 
 
@@ -417,6 +535,8 @@ def measure():
     out["speedup_e2e"] = round(
         out["pipelined"]["e2e_tps"] / max(out["blocking"]["e2e_tps"],
                                           1e-9), 2)
+    out["ownership"] = ownership_chain(depth=16)
+    out["sched_compare"] = sched_compare(n=N)
     out["multi_driver"] = multi_driver(k=DRIVERS, n_per_driver=N)
     out["node_flatness"] = node_flatness(n=200)
     out["tracing_overhead"] = trace_overhead(N, reps=2)
@@ -455,6 +575,9 @@ def smoke():
     assert on_ <= max(off * 1.02, off + 2.0), (
         f"health-gauge overhead too high: p50 {off} -> {on_} us ({hv})")
     rec["health_overhead"] = hv
+    # ownership invariant (ISSUE 17): a driver-local small-object chain
+    # costs ZERO blocking round trips — asserted inside ownership_chain
+    rec["ownership"] = ownership_chain(depth=8, reps=1)
     print(json.dumps({"bench": "core_control_plane_smoke", **rec}))
 
 

@@ -8,8 +8,14 @@ registrations, refcount deltas, task_done publications and pipelined
 submits. This codec packs those frames as fixed-layout structs instead of
 pickle:
 
-  frame: u8 magic 0xC3 | u8 version 1 | u8 kind (1=batch) | u32 nentries | entry*
+  frame: u8 magic 0xC3 | u8 version 1 | u8 kind (1=batch, 2=exec) |
+         u32 nentries | entry*
   entry: u8 opcode | u32 body_len | body
+
+Kind 2 ("exec") is the scheduler's dispatch frame — exactly one OP_EXEC
+entry carrying the TaskSpec, result oids and prefetched arg descriptors —
+so the per-dispatch hot path skips pickle too (controller._dispatch sends
+it codec-coded once the worker negotiated codec_ver > 0).
 
 Pickle frames always begin 0x80 (protocol >= 2), so receivers sniff the
 first byte — protocol.recv_msg/aread_msg route 0xC3 frames here and
@@ -46,6 +52,7 @@ from . import objdir
 MAGIC = 0xC3
 VERSION = 1
 KIND_BATCH = 1
+KIND_EXEC = 2   # dispatch frame: exactly one OP_EXEC entry
 
 OP_REFDELTAS = 1
 OP_PUT = 2
@@ -57,6 +64,7 @@ OP_TASK_DONE = 7
 OP_SUBMIT = 8
 OP_INCREF_ONE = 9
 OP_DECREF_ONE = 10
+OP_EXEC = 11    # kind-2 frames only (batch frames stop at 10)
 
 _HDR = struct.Struct("<BBBI")   # magic, version, kind, nentries
 _ENT = struct.Struct("<BI")     # opcode, body_len
@@ -263,6 +271,7 @@ _SPEC_EXTRAS = (
     ("placement_group_bundle_index", -1), ("runtime_env", None),
     ("generator_backpressure", 0), ("parent_task_id", None), ("job_id", None),
     ("trace_id", None), ("parent_span_id", None), ("nested_refs", []),
+    ("owner_id", None), ("owned_inline", None),
 )
 
 
@@ -309,6 +318,65 @@ def _dec_spec(mv, pos: int):
         for k, v in pickle.loads(extras_blob).items():
             setattr(spec, k, v)
     return spec, pos
+
+
+def _enc_exec(parts: list, payload: dict) -> None:
+    """Exec-frame body: spec | u16 n | str* result_oids | u8 has_descs |
+    [u16 n | (str oid | u8 tag | inline bytes / u32 shm meta_len)*].
+    Raises on desc kinds outside inline/shm (caller falls back to pickle)."""
+    _enc_spec(parts, payload["spec"])
+    oids = payload["result_oids"]
+    parts.append(_U16.pack(len(oids)))
+    for oid in oids:
+        _pstr(parts, oid)
+    descs = payload.get("arg_descs")
+    if descs is None:
+        parts.append(b"\x00")
+        return
+    parts.append(b"\x01")
+    parts.append(_U16.pack(len(descs)))
+    for oid, (kind, v) in descs.items():
+        _pstr(parts, oid)
+        if kind == "inline":
+            b = bytes(v)
+            parts.append(b"\x00" + _U32.pack(len(b)))
+            parts.append(b)
+        elif kind == "shm":
+            parts.append(b"\x01" + _U32.pack(int(v)))
+        else:
+            raise ValueError(f"no exec layout for desc kind {kind!r}")
+
+
+def _dec_exec(mv) -> dict:
+    spec, pos = _dec_spec(mv, 0)
+    (n,) = _U16.unpack_from(mv, pos)
+    pos += 2
+    oids = []
+    for _ in range(n):
+        oid, pos = _gstr(mv, pos)
+        oids.append(oid)
+    out = {"spec": spec, "result_oids": oids}
+    has_descs = mv[pos]
+    pos += 1
+    if has_descs:
+        (nd,) = _U16.unpack_from(mv, pos)
+        pos += 2
+        descs = {}
+        for _ in range(nd):
+            oid, pos = _gstr(mv, pos)
+            tag = mv[pos]
+            pos += 1
+            if tag == 0:
+                (ln,) = _U32.unpack_from(mv, pos)
+                pos += 4
+                descs[oid] = ("inline", bytes(mv[pos:pos + ln]))
+                pos += ln
+            else:
+                (ml,) = _U32.unpack_from(mv, pos)
+                pos += 4
+                descs[oid] = ("shm", ml)
+        out["arg_descs"] = descs
+    return out
 
 
 def _enc_entry(e) -> Tuple[int, bytes]:
@@ -418,6 +486,18 @@ def fold_refdeltas(entries):
 def encode(kind: str, payload: dict) -> Optional[bytes]:
     """Encode a frame, or None when `kind`/payload has no fixed layout (the
     sender then pickles — the negotiated fallback)."""
+    if kind == "exec":
+        if not ({"spec", "result_oids"} <= set(payload)
+                <= {"spec", "result_oids", "arg_descs"}):
+            return None
+        try:
+            body_parts: list = []
+            _enc_exec(body_parts, payload)
+            body = b"".join(body_parts)
+            return b"".join([_HDR.pack(MAGIC, VERSION, KIND_EXEC, 1),
+                             _ENT.pack(OP_EXEC, len(body)), body])
+        except Exception:  # noqa: BLE001 - opportunistic: odd specs pickle
+            return None
     if kind != "batch" or set(payload) != {"entries"}:
         return None
     try:
@@ -438,9 +518,12 @@ def _scan_py(data) -> List[Tuple[int, int, int]]:
         raise ValueError("not a codec frame")
     if mv[1] != VERSION:
         raise ValueError(f"unsupported codec version {mv[1]}")
-    if mv[2] != KIND_BATCH:
-        raise ValueError(f"unknown codec frame kind {mv[2]}")
+    kind = mv[2]
+    if kind not in (KIND_BATCH, KIND_EXEC):
+        raise ValueError(f"unknown codec frame kind {kind}")
     (n,) = _U32.unpack_from(mv, 3)
+    if kind == KIND_EXEC and n != 1:
+        raise ValueError("malformed codec frame")
     pos = 7
     out = []
     for _ in range(n):
@@ -448,7 +531,9 @@ def _scan_py(data) -> List[Tuple[int, int, int]]:
             raise ValueError("malformed codec frame")
         opcode, blen = _ENT.unpack_from(mv, pos)
         pos += 5
-        if opcode < 1 or opcode > OP_DECREF_ONE or pos + blen > len(mv):
+        op_ok = (1 <= opcode <= OP_DECREF_ONE if kind == KIND_BATCH
+                 else opcode == OP_EXEC)
+        if not op_ok or pos + blen > len(mv):
             raise ValueError("malformed codec frame")
         out.append((opcode, pos, blen))
         pos += blen
@@ -482,6 +567,9 @@ def decode(data):
     lib = None if native_disabled() else _load()
     items = _scan_native(lib, data) if lib is not None else _scan_py(data)
     mv = memoryview(data)
+    if data[2] == KIND_EXEC:
+        op, off, ln = items[0]
+        return ("exec", _dec_exec(mv[off:off + ln]))
     entries = [_dec_entry(op, mv[off:off + ln]) for op, off, ln in items]
     return ("batch", {"entries": entries})
 

@@ -76,6 +76,17 @@ def _load():
                                 ctypes.c_int32,
                                 ctypes.POINTER(ctypes.c_int32)]
         lib.sq_next.restype = ctypes.c_int64
+        lib.sq_schedule.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_uint8),
+                                    ctypes.POINTER(ctypes.c_int32),
+                                    ctypes.c_int32,
+                                    ctypes.POINTER(ctypes.c_int32),
+                                    ctypes.c_int32,
+                                    ctypes.POINTER(ctypes.c_int64),
+                                    ctypes.POINTER(ctypes.c_int32),
+                                    ctypes.c_int32,
+                                    ctypes.POINTER(ctypes.c_int64)]
+        lib.sq_schedule.restype = ctypes.c_int64
         lib.sq_pop_task.argtypes = [ctypes.c_void_p, ctypes.c_int64]
         lib.sq_pool_avail.argtypes = [ctypes.c_void_p, ctypes.c_int64,
                                       ctypes.c_int32]
@@ -172,6 +183,31 @@ class ReadyQueue:
 
     def pop_task(self, task_seq: int):
         self._lib.sq_pop_task(self._h, task_seq)
+
+    def schedule_batch(self, sig_modes: List[int], sig_buckets: List[int],
+                       bucket_idle: List[int], max_out: int = 1024
+                       ) -> Tuple[List[Tuple[int, int]], int, int]:
+        """Batched scheduling pass under a single GIL release.
+
+        sig_modes[i]: 0 skip, 1 plain (needs idle worker in its bucket),
+        2 python-handled barrier (actor creation). sig_buckets[i] indexes
+        bucket_idle (idle-worker count per (tpu, env) class; -1 for mode 2).
+        Pops + claims every decision natively. Returns
+        (decisions [(seq, sig), ...], barrier_sig, barrier_seq) where
+        barrier_sig == -1 means the pass ran to exhaustion.
+        """
+        n = len(sig_modes)
+        modes = (ctypes.c_uint8 * n)(*sig_modes)
+        buckets = (ctypes.c_int32 * n)(*sig_buckets)
+        nb = len(bucket_idle)
+        idle = (ctypes.c_int32 * max(nb, 1))(*bucket_idle)
+        out_seqs = (ctypes.c_int64 * max_out)()
+        out_sigs = (ctypes.c_int32 * max_out)()
+        barrier = (ctypes.c_int64 * 2)(-1, -1)
+        cnt = self._lib.sq_schedule(self._h, modes, buckets, n, idle, nb,
+                                    out_seqs, out_sigs, max_out, barrier)
+        decisions = [(out_seqs[i], out_sigs[i]) for i in range(cnt)]
+        return decisions, int(barrier[0]), int(barrier[1])
 
 
 class PyReadyQueue:
@@ -270,6 +306,48 @@ class PyReadyQueue:
                 self._sigs[sig][2].remove(task_seq)
             except ValueError:
                 pass
+
+    def schedule_batch(self, sig_modes, sig_buckets, bucket_idle,
+                       max_out=1024):
+        # semantically identical to sq_schedule (see ReadyQueue) — the
+        # randomized equivalence tests drive both with the same sequences
+        idle = list(bucket_idle)
+        decisions = []
+        while len(decisions) < max_out:
+            best_seq, best_sig = -1, -1
+            for i, (pool_id, need, fifo) in enumerate(self._sigs):
+                if i >= len(sig_modes):
+                    break
+                mode = sig_modes[i]
+                if not mode:
+                    continue
+                while fifo and fifo[0] not in self._alive:
+                    fifo.pop(0)
+                if not fifo:
+                    continue
+                if best_seq != -1 and fifo[0] >= best_seq:
+                    continue
+                if mode == 1:
+                    b = sig_buckets[i]
+                    if b < 0 or b >= len(idle) or idle[b] <= 0:
+                        continue
+                if not self._fits(pool_id, need):
+                    continue
+                best_seq, best_sig = fifo[0], i
+            if best_seq == -1:
+                return decisions, -1, -1
+            if sig_modes[best_sig] == 2:
+                return decisions, best_sig, best_seq
+            pool_id, need, fifo = self._sigs[best_sig]
+            fifo.pop(0)
+            self._alive.pop(best_seq, None)
+            self._live[best_sig] -= 1
+            pool = self._pools.setdefault(pool_id, {})
+            for k, v in need.items():
+                pool[k] = pool.get(k, 0.0) - float(v)
+            idle[sig_buckets[best_sig]] -= 1
+            decisions.append((best_seq, best_sig))
+        return decisions, -1, -1
 
 
 def make_ready_queue():

@@ -16,6 +16,12 @@ reference-count RPCs, core_worker.cc / reference_count.cc):
   pipelined submit itself) forces a flush first, so a batch entry can never
   be applied after a frame that was issued later — and a decref can never
   overtake the put that created its ref.
+- client-owned small objects (ref: Ray's ownership model): this client owns
+  its own inline puts and the returns of tasks it submits. Descriptors live
+  in a local _OwnedTable; the head (a write-behind cache for these) pushes
+  result descriptors back unsolicited, so an owner-local chain
+  `f.remote(g.remote(x))` + get() completes with ZERO blocking control
+  round trips. RAY_TPU_OWNERSHIP=0 restores head-owned-everything.
 """
 
 import collections
@@ -80,7 +86,10 @@ def _ref_trace(oid: str):
 # controller loop starts crunching the batch: on a small host they share
 # cores, and a nap that expires mid-burst preempts the submit loop. Blocking
 # consumers force-flush, so only pure fire-and-forget sees the nap at all.
-_FLUSH_MAX_ENTRIES = int(os.environ.get("RAY_TPU_FLUSH_MAX_ENTRIES", "128"))
+# 512 (was 128): the controller applies a whole batch in one loop step and
+# its native schedule pass is batched too, so bigger drains cost the loop
+# side little — while each extra flush preempts the submit thread mid-burst.
+_FLUSH_MAX_ENTRIES = int(os.environ.get("RAY_TPU_FLUSH_MAX_ENTRIES", "512"))
 _FLUSH_MAX_BYTES = int(os.environ.get("RAY_TPU_FLUSH_MAX_BYTES",
                                       str(256 * 1024)))
 _FLUSH_INTERVAL_S = float(os.environ.get("RAY_TPU_FLUSH_INTERVAL_S", "0.008"))
@@ -96,6 +105,118 @@ def _prefetch_enabled() -> bool:
     # controller module into every worker process
     return os.environ.get("RAY_TPU_PREFETCH", "1").lower() not in (
         "0", "false", "no")
+
+
+def _ownership_enabled() -> bool:
+    # client-owned small objects (mirrors controller.ownership); the
+    # RAY_TPU_OWNERSHIP=0 escape hatch restores head-owned-everything
+    return os.environ.get("RAY_TPU_OWNERSHIP", "1").lower() not in (
+        "0", "false", "no")
+
+
+class _OwnedTable:
+    """Client-LOCAL descriptor table for objects this client owns (ref: Ray
+    ownership — the submitting worker owns its returns,
+    reference_count.cc). Entries are registered at put()/submit() time; the
+    head pushes result descriptors back over the existing channel
+    (controller._push_owned → "owned" frames / the driver's in-process
+    sink), so an owner-local get() resolves HERE with zero round trips —
+    the head's object directory is only a write-behind cache for these.
+
+    entry: [desc, event, rc, inline_parts]
+      desc          ("inline", bytes) | ("err", exc) | ("head", None) |
+                    None while the producing task is in flight
+      event         lazily-created waiter (created under the lock, so a
+                    concurrent resolve can't slip between check and wait)
+      rc            local ref mirror; the entry dies at zero
+      inline_parts  (meta_len, size, bytes) for resolved inline values —
+                    what submit() ships as TaskSpec.owned_inline
+    """
+
+    __slots__ = ("_lock", "_entries")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}
+
+    def add_resolved(self, oid, payload, meta_len, size):
+        with self._lock:
+            self._entries[oid] = [("inline", payload), None, 1,
+                                  (meta_len, size, payload)]
+
+    def add_pending(self, oids):
+        with self._lock:
+            for oid in oids:
+                self._entries[oid] = [None, None, 1, None]
+
+    def resolve(self, entries):
+        """Descriptor push from the head (controller loop thread for the
+        driver sink, recv thread for workers): fill descriptors, wake
+        waiters. Unknown oids (entry already dropped at rc 0) are ignored."""
+        with self._lock:
+            for oid, kind, payload, meta_len, size in entries:
+                e = self._entries.get(oid)
+                if e is None:
+                    continue
+                if kind == "inline":
+                    e[0] = ("inline", payload)
+                    e[3] = (meta_len, size, payload)
+                elif kind == "err":
+                    e[0] = ("err", payload)
+                else:  # bytes live in shm/another node: head serves the get
+                    e[0] = ("head", None)
+                if e[1] is not None:
+                    e[1].set()
+
+    def resolve_results(self, results):
+        """Self-execution: a worker that executes a task IT submitted seals
+        its own owned results here (the head sees owner == sender there and
+        skips the push)."""
+        entries = []
+        for r in results:
+            if r[0] in self._entries:
+                entries.append((r[0],
+                                "inline" if r[3] is not None else "head",
+                                r[3], r[1], r[2]))
+        if entries:
+            self.resolve(entries)
+
+    def peek(self, oid):
+        """Resolved descriptor or None (absent or still pending)."""
+        e = self._entries.get(oid)
+        return e[0] if e is not None else None
+
+    def waiter(self, oid):
+        """(desc, event): a resolved descriptor, or the event a pending
+        entry's resolve will set, or (None, None) when the oid isn't owned
+        here."""
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is None:
+                return None, None
+            if e[0] is not None:
+                return e[0], None
+            if e[1] is None:
+                e[1] = threading.Event()
+            return None, e[1]
+
+    def inline_parts(self, oid):
+        e = self._entries.get(oid)
+        return e[3] if e is not None else None
+
+    def incref(self, oid):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e[2] += 1
+
+    def decref(self, oid):
+        with self._lock:
+            e = self._entries.get(oid)
+            if e is not None:
+                e[2] -= 1
+                if e[2] <= 0:
+                    del self._entries[oid]
 
 
 class _SingleFlight:
@@ -192,6 +313,23 @@ class _DeltaFlusher:
         if not self._wake.is_set():
             self._wake.set()
 
+    def append_entry(self, entry):
+        """append() minus the byte/urgency accounting — the pipelined submit
+        path, where every entry is small and non-urgent. Falls back to the
+        general path for the rare states (closed, timer not yet running)."""
+        lock = self.lock
+        lock.acquire()
+        if self._closed or self._thread is None:
+            lock.release()
+            return self.append(entry)
+        entries = self._entries
+        entries.append(entry)
+        if len(entries) >= _FLUSH_MAX_ENTRIES:
+            self._urgent = True
+        lock.release()
+        if not self._wake.is_set():
+            self._wake.set()
+
     def drain_locked(self):
         """Take the pending entries without sinking them (the caller ships
         them itself, e.g. fused with a pipelined submit). Lock must be held."""
@@ -238,6 +376,78 @@ class BaseClient:
     def __init__(self):
         self.store = StoreClient()
         self.job_id = None
+        self._owned = None  # _OwnedTable when the ownership model is active
+        # Precomputed pipelined-submit fast lane consumed by
+        # RemoteFunction.remote() for single-return tasks:
+        # (owner label or None, flusher append_entry, owned entries dict or
+        # None). Mirrors the nr==1 arm of submit() — keep the two in sync.
+        # None when submits must go through submit() (sync mode).
+        self._lane = None
+
+    def _resolve_owned(self, uniq, timeout):
+        """Serve what the ownership table can from LOCAL state. Returns
+        (descs, remaining): `descs` maps owned oids to materializable
+        descriptors, `remaining` lists what the head must serve (not owned
+        here, or owned bytes living in shm/another node). PENDING owned
+        entries are waited on here — their descriptor arrives as an
+        unsolicited push on the background channel, so the wait costs zero
+        control round trips (metrics.control_local_gets_total counts the
+        serves; the ownership bench section asserts the zero)."""
+        owned = self._owned
+        if owned is None:
+            return {}, uniq
+        descs, remaining, waits = {}, [], []
+        for o in uniq:
+            desc, ev = owned.waiter(o)
+            if desc is not None:
+                if desc[0] == "head":
+                    remaining.append(o)
+                else:
+                    descs[o] = desc
+            elif ev is not None:
+                waits.append((o, ev))
+            else:
+                remaining.append(o)
+        if waits:
+            self.flush()  # the producing submit may still sit in the batch
+            deadline = None if timeout is None else (
+                time.monotonic() + timeout)
+            for o, ev in waits:
+                left = (None if deadline is None
+                        else deadline - time.monotonic())
+                if (left is not None and left <= 0) or not ev.wait(left):
+                    raise exc.GetTimeoutError(
+                        f"get() timed out waiting for owned object {o}")
+                desc = owned.peek(o)
+                if desc is None or desc[0] == "head":
+                    remaining.append(o)
+                else:
+                    descs[o] = desc
+        if descs:
+            protocol.note_local_get(len(descs))
+        return descs, remaining
+
+    def _attach_owned_args(self, spec):
+        """Copy resolved inline descriptors for owned ref args INTO the spec
+        (TaskSpec.owned_inline): the spec stays self-contained, so a head
+        that forwards it to another node never round-trips back to the
+        owner for small args."""
+        owned = self._owned
+        inline = None
+        for kind, v in spec.args:
+            if kind == "ref":
+                parts = owned.inline_parts(v)
+                if parts is not None:
+                    inline = inline if inline is not None else {}
+                    inline[v] = parts
+        for kind, v in spec.kwargs.values():
+            if kind == "ref":
+                parts = owned.inline_parts(v)
+                if parts is not None:
+                    inline = inline if inline is not None else {}
+                    inline[v] = parts
+        if inline:
+            spec.owned_inline = inline
 
     def _materialize(self, oids, descs):
         out = []
@@ -289,6 +499,16 @@ class DriverClient(BaseClient):
         self.is_driver = True
         self._pipelined = not _sync_submit_requested()
         self._flusher = _DeltaFlusher(self._post_batch)
+        if self._pipelined and _ownership_enabled():
+            self._owned = _OwnedTable()
+            # in-process descriptor push: the controller's _push_owned calls
+            # this on its loop thread (the table is thread-safe)
+            controller.owner_sinks["driver"] = self._owned.resolve
+        if self._pipelined:
+            self._lane = (
+                "driver" if self._owned is not None else None,
+                self._flusher.append_entry,
+                self._owned._entries if self._owned is not None else None)
 
     def _post_batch(self, entries):
         """Flusher sink: apply a drained batch on the controller loop. Loop
@@ -341,10 +561,32 @@ class DriverClient(BaseClient):
             oids = self._call(self.controller.submit(spec))
             _note_ref_trace(oids[0], inherited)
             return oids
-        n = (1 if spec.num_returns == "streaming"
-             else max(spec.num_returns, 1))
-        oids = [ids.object_id_for_return(spec.task_id, i) for i in range(n)]
-        _note_ref_trace(oids[0], inherited)
+        nr = spec.num_returns
+        owned = self._owned
+        if nr == 1:  # dominant case: skip the listcomp + per-id call
+            oid = "obj-" + spec.task_id + "-ret0"
+            oids = [oid]
+            if owned is not None:
+                spec.owner_id = "driver"
+                # add_pending inlined, lock-free: a dict store is GIL-atomic
+                # and the entry is unreachable by any other thread until this
+                # call returns the ObjectRef (resolve only fires after the
+                # flusher ships the spec, strictly later). Owned-arg inline
+                # descriptors are attached by the PRODUCER of the spec
+                # (remote_function / actor) — not here — so scalar-only
+                # submits skip the arg scan entirely.
+                owned._entries[oid] = [None, None, 1, None]
+        else:
+            n = 1 if nr == "streaming" else max(nr, 1)
+            oids = [ids.object_id_for_return(spec.task_id, i)
+                    for i in range(n)]
+            if owned is not None and nr != "streaming":
+                # this driver owns the returns: pending table entries now,
+                # the head pushes descriptors back when the task completes
+                spec.owner_id = "driver"
+                owned.add_pending(oids)
+        if inherited is not None:
+            _note_ref_trace(oids[0], inherited)
         # the submit itself is a batch entry: a tight submit loop posts ONE
         # loop callback per drained batch instead of one call_soon_threadsafe
         # (and one loop self-pipe write) per task. Append order keeps the
@@ -353,17 +595,22 @@ class DriverClient(BaseClient):
         # tight submit loop into a 3-thread GIL ping-pong. Every blocking
         # consumer (get/wait/_call) force-flushes first, so the only cost of
         # lazy dispatch is ≤ one coalescing nap on pure fire-and-forget.
-        self._flusher.append(("submit", spec, oids))
+        self._flusher.append_entry(("submit", spec, oids))
         return oids
 
     def get(self, oids, timeout=None):
         t0 = time.time() if tracing.enabled() else 0.0
         # dedup before the fetch: a get([r, r, ...]) waits/pulls each unique
-        # object once, then fans the descriptors back out in caller order
+        # object once, then fans the descriptors back out in caller order.
+        # Owned objects resolve from the local table first — a fully-owned
+        # get never posts to the controller loop at all.
         uniq = list(dict.fromkeys(oids))
-        descs = self._call(self.controller.get_descriptors(uniq, timeout),
-                           timeout=None if timeout is None else timeout + 5)
-        by_oid = dict(zip(uniq, descs))
+        by_oid, remaining = self._resolve_owned(uniq, timeout)
+        if remaining:
+            descs = self._call(
+                self.controller.get_descriptors(remaining, timeout),
+                timeout=None if timeout is None else timeout + 5)
+            by_oid.update(zip(remaining, descs))
         out = self._materialize(oids, [by_oid[o] for o in oids])
         if t0:
             tracing.record_span(
@@ -383,6 +630,9 @@ class DriverClient(BaseClient):
             self._call_soon(self.controller.register_put, oid, meta_len,
                             size, inline, contained)
             return
+        if self._owned is not None and inline is not None:
+            # this driver owns its own put: gets resolve locally from now on
+            self._owned.add_resolved(oid, inline, meta_len, size)
         self._flusher.append(("put", oid, meta_len, size, inline, contained),
                              nbytes=len(inline) if inline is not None else 0)
 
@@ -402,11 +652,16 @@ class DriverClient(BaseClient):
         return self._call_soon(self.controller.register_actor, spec, options)
 
     # deltas ride the flusher (the sink swallows loop-closed RuntimeError at
-    # shutdown, like the old direct call_soon_threadsafe wrappers did)
+    # shutdown, like the old direct call_soon_threadsafe wrappers did); the
+    # owned table mirrors the refcount so its entries die with the last ref
     def decref(self, oid):
+        if self._owned is not None:
+            self._owned.decref(oid)
         self._flusher.append(("decref", oid))
 
     def incref(self, oid):
+        if self._owned is not None:
+            self._owned.incref(oid)
         self._flusher.append(("incref", oid))
 
     def actor_incref(self, actor_id):
@@ -519,7 +774,14 @@ class WorkerClient(BaseClient):
         # write — batch frames included — stays serialized and ordered
         self._lock = threading.RLock()
         self._pipelined = not _sync_submit_requested()
+        self._owned = (_OwnedTable()
+                       if self._pipelined and _ownership_enabled() else None)
         self._flusher = _DeltaFlusher(self._send_batch, self._lock)
+        if self._pipelined:
+            self._lane = (
+                worker_id if self._owned is not None else None,
+                self._flusher.append_entry,
+                self._owned._entries if self._owned is not None else None)
         self._getflight = _SingleFlight()  # cross-thread get dedup
         self._reqs = {}
         self._req_counter = 0
@@ -594,6 +856,10 @@ class WorkerClient(BaseClient):
                     self.task_available.notify_all()
             elif kind == "cancel_exec":
                 self._cancel_exec(p["task_id"])
+            elif kind == "owned":
+                # unsolicited descriptor push for objects this client owns
+                if self._owned is not None:
+                    self._owned.resolve(p["entries"])
             elif kind == "resp":
                 fut = self._reqs.pop(p.pop("req_id"), None)
                 if fut is not None and not fut.done():
@@ -648,15 +914,31 @@ class WorkerClient(BaseClient):
             oids = self._rpc("submit", spec=spec)["refs"]
             _note_ref_trace(oids[0], inherited)
             return oids
-        n = (1 if spec.num_returns == "streaming"
-             else max(spec.num_returns, 1))
-        oids = [ids.object_id_for_return(spec.task_id, i) for i in range(n)]
-        _note_ref_trace(oids[0], inherited)
+        nr = spec.num_returns
+        owned = self._owned
+        if nr == 1:  # dominant case: skip the listcomp + per-id call
+            oid = "obj-" + spec.task_id + "-ret0"
+            oids = [oid]
+            if owned is not None:
+                # this worker owns the returns of tasks IT submits (nested
+                # tasks): the head pushes descriptors back as "owned" frames.
+                # add_pending inlined lock-free (see DriverClient.submit).
+                spec.owner_id = self.worker_id
+                owned._entries[oid] = [None, None, 1, None]
+        else:
+            n = 1 if nr == "streaming" else max(nr, 1)
+            oids = [ids.object_id_for_return(spec.task_id, i)
+                    for i in range(n)]
+            if owned is not None and nr != "streaming":
+                spec.owner_id = self.worker_id
+                owned.add_pending(oids)
+        if inherited is not None:
+            _note_ref_trace(oids[0], inherited)
         # fire-and-forget batch entry: append order keeps the spec behind
         # the put registrations of its own arguments, and a tight submit
         # loop shares one frame across many submits (non-urgent: blocking
         # RPCs flush, so only fire-and-forget pays the coalescing nap)
-        self._flusher.append(("submit", spec, oids))
+        self._flusher.append_entry(("submit", spec, oids))
         return oids
 
     def get(self, oids, timeout=None):
@@ -668,18 +950,20 @@ class WorkerClient(BaseClient):
         try:
             # dedup: each unique object crosses the wire (and pulls) once —
             # across exec THREADS too: concurrent getters of an oid join the
-            # owner's in-flight claim instead of issuing their own RPC
+            # claimant's in-flight claim instead of issuing their own RPC.
+            # Owned objects short-circuit first: their descriptors live (or
+            # will arrive) in the local ownership table — no RPC at all.
             uniq = list(dict.fromkeys(oids))
-            owned, joined = self._getflight.claim(uniq)
-            descs = {}
-            if owned:
+            descs, remaining = self._resolve_owned(uniq, timeout)
+            mine, joined = self._getflight.claim(remaining)
+            if mine:
                 try:
-                    p = self._rpc("get", oids=owned, timeout=timeout)
+                    p = self._rpc("get", oids=mine, timeout=timeout)
                 except BaseException as e:
-                    for o in owned:
+                    for o in mine:
                         self._getflight.fail(o, e)
                     raise
-                for o, d in zip(owned, p["results"]):
+                for o, d in zip(mine, p["results"]):
                     descs[o] = d
                     self._getflight.resolve(o, d)
             for o, f in joined.items():
@@ -706,6 +990,9 @@ class WorkerClient(BaseClient):
             self._rpc("put", oid=oid, meta_len=meta_len, size=size,
                       inline=inline, contained=contained)
             return
+        if self._owned is not None and inline is not None:
+            # this worker owns its own put: gets resolve locally from now on
+            self._owned.add_resolved(oid, inline, meta_len, size)
         self._flusher.append(("put", oid, meta_len, size, inline, contained),
                              nbytes=len(inline) if inline is not None else 0)
 
@@ -727,6 +1014,11 @@ class WorkerClient(BaseClient):
         phase spans; None when tracing is off/unsampled. `spans` is the
         drained tracing ship-outbox (Chrome-format dicts): app windows
         recorded in THIS worker during exec, bound for the head timeline."""
+        if self._owned is not None and results:
+            # results of a task this worker itself submitted (dispatch looped
+            # back here): the head skips the owner push when owner == sender,
+            # so seal our own table directly
+            self._owned.resolve_results(results)
         if self._pipelined and _prefetch_enabled():
             # urgent: the flusher timer skips its coalescing nap — callers
             # may already be blocked in ray.get() on these results
@@ -762,11 +1054,16 @@ class WorkerClient(BaseClient):
         return self._rpc("register_actor_rpc", spec=spec, options=options)["actor_id"]
 
     # deltas ride the flusher (append cannot fail; the sink swallows OSError
-    # at shutdown, like the old per-message try/except did)
+    # at shutdown, like the old per-message try/except did); the owned table
+    # mirrors the refcount so its entries die with the last local ref
     def decref(self, oid):
+        if self._owned is not None:
+            self._owned.decref(oid)
         self._flusher.append(("decref", oid))
 
     def incref(self, oid):
+        if self._owned is not None:
+            self._owned.incref(oid)
         self._flusher.append(("incref", oid))
 
     def actor_incref(self, actor_id):

@@ -59,6 +59,7 @@ from typing import Dict, List, Optional, Set
 
 from .. import exceptions as exc
 from .._native import codec as _codec
+from ..util.scheduling_strategies import NodeAffinitySchedulingStrategy
 from . import protocol
 from .task_spec import TaskSpec
 
@@ -117,6 +118,15 @@ class ClusterServer:
         self._reqs: Dict[int, asyncio.Future] = {}
         self._req_counter = 0
         self._rr = 0  # SPREAD round-robin cursor
+        # Grow-only union of every known node's static resource keys,
+        # rebuilt lazily when the node count changes. place()'s hot path
+        # uses it to prove "no node could EVER fit this demand" in
+        # O(demand kinds) — head-pinned tasks (a custom resource only the
+        # head advertises) skip the O(nodes) fitting/feasible scans
+        # entirely. Never shrunk on node death: a stale key only disables
+        # the shortcut, and the full scans handle dead nodes.
+        self._node_res_keys: Set[str] = set()
+        self._node_res_len = -1  # len(self.nodes) at last union rebuild
         self._sweeper: Optional[asyncio.Task] = None
         self.staged_bytes = 0  # bytes the head staged for node↔node moves
         #                        (fallback path only — should stay ~0)
@@ -338,9 +348,8 @@ class ClusterServer:
         stream table, and methods follow their actor."""
         spec: TaskSpec = rec.spec
         strat = spec.scheduling_strategy
-        live = [n for n in self.nodes.values() if n.alive]
-        from ..util.scheduling_strategies import NodeAffinitySchedulingStrategy
         if isinstance(strat, NodeAffinitySchedulingStrategy):
+            live = self._live()
             if getattr(strat, "locality_hint", False):
                 # data-layer owner tag: run WHERE THE BLOCK IS. A merely
                 # busy target still wins — the task queues there (ref: the
@@ -373,20 +382,38 @@ class ClusterServer:
         if strat == "SPREAD":
             # round-robin over head + fitting nodes (ref: SPREAD is
             # best-effort dispersal, scheduling_policy.cc)
-            slots = [None] + [n for n in live
+            slots = [None] + [n for n in self._live()
                               if self._fits(spec.resources, n.available)]
             if not slots:
                 return None
             self._rr += 1
             return slots[self._rr % len(slots)]
-        return self._default_place(spec, live)
+        return self._default_place(spec)
+
+    def _live(self) -> List[NodeConn]:
+        return [n for n in self.nodes.values() if n.alive]
+
+    def _node_keys(self) -> Set[str]:
+        if len(self.nodes) != self._node_res_len:
+            self._node_res_len = len(self.nodes)
+            for n in self.nodes.values():
+                self._node_res_keys.update(n.resources)
+        return self._node_res_keys
 
     def _head_free(self) -> Dict[str, float]:
         """Head resources not yet spoken for: `available` minus the demand
-        of locally-queued PENDING tasks (claims happen at dispatch, so the
-        raw pool would let every task in one burst 'fit locally' and never
-        overflow to a node)."""
+        of locally-queued tasks (claims happen at dispatch, so the raw pool
+        would let every task in one burst 'fit locally' and never overflow
+        to a node). Reads the ready queue's incrementally-maintained
+        aggregate — place() runs once per submit, so an O(queue) rescan
+        here turns a burst quadratic and sinks multi-node submit tps.
+        Unit-test doubles hand us a plain list of recs; scan those."""
         free = dict(self.c.available)
+        pending = getattr(self.c.ready_queue, "pending_demand", None)
+        if pending is not None:
+            for k, v in pending.items():
+                free[k] = free.get(k, 0) - v
+            return free
         for rec in self.c.ready_queue:
             if rec.state == "PENDING":
                 for k, v in rec.spec.resources.items():
@@ -427,18 +454,24 @@ class ClusterServer:
             metrics.get_or_create(metrics.Counter,
                                   "sched_locality_bytes").inc(nbytes)
 
-    def _default_place(self, spec: TaskSpec, live: List[NodeConn]):
+    def _default_place(self, spec: TaskSpec, live: List[NodeConn] = None):
         """Locality first: among candidates with free resources, place on
         the one already holding the most arg bytes (ref: the Ray paper's
         locality-aware lease policy; scheduling_policy.cc hybrid policy).
         No locality signal — or no holder with room — falls back to the r5
         resource policy: local if it fits now; else the least-loaded node
         where it fits now; else local if EVER feasible locally; else any
-        node where it is feasible (queue there)."""
+        node where it is feasible (queue there).
+
+        Runs once per submitted task, so the hot path (no locality signal,
+        head-bound demand) must stay O(1) in node count — the `live` list
+        and per-node scans are built only on the branches that need them."""
         res = spec.resources
         head_fits = self._fits(res, self._head_free())
         local = self._locality_bytes(spec)
         if local:
+            if live is None:
+                live = self._live()
             options = [(None, None)] if head_fits else []
             options += [(n.node_id, n) for n in live
                         if self._fits(res, n.available)]
@@ -455,6 +488,14 @@ class ClusterServer:
             self._note_locality(False, 0)
         if head_fits:
             return None
+        if any(v > 1e-9 and k not in self._node_keys()
+               for k, v in res.items()):
+            # demands a resource no node has ever advertised: the fitting
+            # and feasible scans below cannot succeed, so the task is
+            # head-bound either way — skip the O(nodes) work
+            return None
+        if live is None:
+            live = self._live()
         fitting = [n for n in live if self._fits(res, n.available)]
         if fitting:
             return max(fitting, key=lambda n: n.available.get("CPU", 0.0))

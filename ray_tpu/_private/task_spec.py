@@ -51,6 +51,15 @@ class TaskSpec:
     # ObjectRef ids serialized *inside* inline arg values (not top-level ref
     # args); the controller pins them for the task's lifetime like ref args
     nested_refs: List[str] = field(default_factory=list)
+    # ownership (ref: Ray ownership model — the submitting worker owns its
+    # returns, src/ray/core_worker/reference_count.cc): the owner's client id
+    # ("driver" or a worker id); the head pushes result descriptors back to
+    # it so owner-local gets never round-trip. None = head-owned (legacy).
+    owner_id: Optional[str] = None
+    # inline descriptors for owned small-object ref args, riding inside the
+    # spec so it stays self-contained across forwarding:
+    # {oid: (meta_len, size, packed_bytes)}
+    owned_inline: Optional[Dict[str, tuple]] = None
 
 
 @dataclass
@@ -127,8 +136,8 @@ class ObjectMeta:
 
     __slots__ = ("object_id", "meta_len", "inline_value", "spill_path",
                  "error", "creating_task", "contained", "prefetched",
-                 "ts_created", "ts_sealed", "ts_pinned", "ts_released",
-                 "_location", "_refcount", "_pinned", "_size")
+                 "owner", "ts_created", "ts_sealed", "ts_pinned",
+                 "ts_released", "_location", "_refcount", "_pinned", "_size")
 
     def __init__(self, object_id: str, size: int = 0, meta_len: int = 0,
                  location: str = "pending",
@@ -149,6 +158,10 @@ class ObjectMeta:
         self.creating_task = creating_task
         self.contained = list(contained) if contained else []
         self.prefetched = prefetched
+        # owning client id ("driver"/worker id) for inline objects under the
+        # ownership model; None = head-owned. Cleared on owner death
+        # (ownership transfers to the head's write-behind cache).
+        self.owner: Optional[str] = None
         self.ts_created = time.time() if ts_created is None else ts_created
         self.ts_sealed = ts_sealed
         self.ts_pinned = ts_pinned

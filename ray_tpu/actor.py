@@ -100,6 +100,10 @@ class ActorHandle:
             method_name=method_name,
             job_id=client.job_id,
         )
+        if client._owned is not None and (eargs or ekwargs):
+            # owned ref args carry their inline descriptors inside the spec
+            # (the spec producer attaches them — client.submit no longer does)
+            client._attach_owned_args(spec)
         oids = client.submit(spec)
         if num_returns == "streaming":
             return ObjectRefGenerator(spec.task_id)
@@ -214,5 +218,7 @@ class ActorClass:
             resources=res,
         )
         client.register_actor(creation, acopts)
+        if client._owned is not None and (eargs or ekwargs):
+            client._attach_owned_args(creation)
         client.submit(creation)
         return ActorHandle(actor_id, self._method_meta(), name=opts.get("name") or "")

@@ -369,3 +369,10 @@ def sched_locality_hit_rate() -> float:
     c = sched_locality_counters()
     total = c["hits"] + c["misses"]
     return 1.0 if total == 0 else c["hits"] / total
+
+
+def control_local_gets_total() -> int:
+    """Owned objects served from the client-local ownership table — gets
+    that never touched the head (zero round trips, zero frames)."""
+    from ray_tpu._private import protocol
+    return protocol.local_gets_total()

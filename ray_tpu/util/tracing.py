@@ -67,8 +67,16 @@ _id_prefix: str = ""
 _process_label: str = ""
 
 # per-thread current span context: (trace_id, span_id) — set by the worker
-# around task execution so nested submits and log records inherit it
-_ctx = threading.local()
+# around task execution so nested submits and log records inherit it.
+# The class-level default makes `_ctx.trace` a plain attribute read on
+# threads that never set a context (every driver submit): getattr with a
+# default raises-and-catches AttributeError internally per call — measurable
+# on the submit hot path.
+class _Ctx(threading.local):
+    trace: Tuple[Optional[str], Optional[int]] = (None, None)
+
+
+_ctx = _Ctx()
 
 # spans explicitly marked for shipment to the head timeline: a worker's
 # ring is local-only (never drained by any heartbeat), so app code that
@@ -139,10 +147,13 @@ def stamp(spec) -> Optional[str]:
     id is derived from the task id (no mint, no registry write); nested
     submits inherit the surrounding task's trace from the thread-local.
     Returns the trace id ONLY in that inherited case — the one case the
-    caller must note a ref->trace mapping (a derived id needs none)."""
+    caller must note a ref->trace mapping (a derived id needs none).
+
+    NOTE: RemoteFunction.remote()'s fast lane inlines this body (writing
+    into the spec's template dict) — keep the two in sync."""
     if not _enabled:
         return None
-    tid, psid = getattr(_ctx, "trace", (None, None))
+    tid, psid = _ctx.trace
     if tid is None:
         if _sample >= 1.0:
             spec.trace_id = spec.task_id
@@ -171,11 +182,11 @@ def set_current(trace_id: Optional[str], span_id: Optional[int]) -> None:
 
 
 def get_current() -> Tuple[Optional[str], Optional[int]]:
-    return getattr(_ctx, "trace", (None, None))
+    return _ctx.trace
 
 
 def current_trace_id() -> Optional[str]:
-    return getattr(_ctx, "trace", (None, None))[0]
+    return _ctx.trace[0]
 
 
 def record_span(name: str, cat: str, trace_id: Optional[str],

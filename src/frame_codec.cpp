@@ -8,16 +8,18 @@
 //   frame: u8 magic 0xC3 | u8 version 1 | u8 kind | u32 nentries LE | entry*
 //   entry: u8 opcode | u32 body_len LE | body
 //
-// kind: 1 = "batch" (the only natively coded frame kind — task_done, submit
-// and refcount deltas all ride inside batch frames on the pipelined plane).
-// Pickle frames always start with 0x80 (protocol >= 2), so a receiver
-// distinguishes the two by the first byte alone.
+// kind: 1 = "batch" (task_done, submit and refcount deltas all ride inside
+// batch frames on the pipelined plane) | 2 = "exec" (the scheduler's
+// dispatch frame: exactly ONE entry, opcode 11). Pickle frames always start
+// with 0x80 (protocol >= 2), so a receiver distinguishes the two by the
+// first byte alone.
 //
 // opcodes: 1 refdeltas (body = packed incref/decref run, the exact layout
 // obj_directory.cpp:od_apply_deltas consumes — a decoded body feeds the
 // directory with zero per-id Python objects) | 2 put | 3 actor_incref |
 // 4 actor_decref | 5 open_stream | 6 close_stream | 7 task_done | 8 submit |
-// 9 incref_one | 10 decref_one. Body layouts are parsed by the Python side
+// 9 incref_one | 10 decref_one | 11 exec (kind-2 frames only). Body layouts
+// are parsed by the Python side
 // (ray_tpu/_native/codec.py); this file owns the one-pass entry scan and
 // bounds validation so decode does a single C call instead of per-entry
 // struct.unpack round trips.
@@ -31,7 +33,16 @@ namespace {
 constexpr uint8_t kMagic = 0xC3;
 constexpr uint8_t kVersion = 1;
 constexpr uint8_t kKindBatch = 1;
-constexpr uint8_t kOpMax = 10;
+constexpr uint8_t kKindExec = 2;
+constexpr uint8_t kOpMax = 10;   // batch-frame opcode ceiling
+constexpr uint8_t kOpExec = 11;  // the one exec-frame opcode
+
+// kind-sensitive opcode admission: batch frames carry ops 1..10, exec
+// frames exactly one op-11 entry.
+inline bool op_ok(uint8_t kind, uint8_t op) {
+  if (kind == kKindBatch) return op >= 1 && op <= kOpMax;
+  return op == kOpExec;
+}
 
 inline uint32_t rd_u32(const uint8_t* p) {
   return (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
@@ -51,13 +62,15 @@ int64_t fc_validate(const uint8_t* buf, int64_t len) {
   if (len < 7) return -1;
   if (buf[0] != kMagic) return -2;
   if (buf[1] != kVersion) return -3;
-  if (buf[2] != kKindBatch) return -4;
+  uint8_t kind = buf[2];
+  if (kind != kKindBatch && kind != kKindExec) return -4;
   uint32_t n = rd_u32(buf + 3);
+  if (kind == kKindExec && n != 1) return -4;
   int64_t pos = 7;
   for (uint32_t i = 0; i < n; i++) {
     if (pos + 5 > len) return -1;
     uint8_t op = buf[pos];
-    if (op < 1 || op > kOpMax) return -5;
+    if (!op_ok(kind, op)) return -5;
     uint32_t blen = rd_u32(buf + pos + 1);
     pos += 5;
     if (pos + (int64_t)blen > len) return -1;
@@ -76,14 +89,16 @@ int64_t fc_scan(const uint8_t* buf, int64_t len, int64_t* out,
   if (len < 7) return -1;
   if (buf[0] != kMagic) return -2;
   if (buf[1] != kVersion) return -3;
-  if (buf[2] != kKindBatch) return -4;
+  uint8_t kind = buf[2];
+  if (kind != kKindBatch && kind != kKindExec) return -4;
   uint32_t n = rd_u32(buf + 3);
+  if (kind == kKindExec && n != 1) return -4;
   if ((int64_t)n > cap_items) return -6;
   int64_t pos = 7;
   for (uint32_t i = 0; i < n; i++) {
     if (pos + 5 > len) return -1;
     uint8_t op = buf[pos];
-    if (op < 1 || op > kOpMax) return -5;
+    if (!op_ok(kind, op)) return -5;
     uint32_t blen = rd_u32(buf + pos + 1);
     pos += 5;
     if (pos + (int64_t)blen > len) return -1;

@@ -14,6 +14,11 @@
 // Exposed as a flat C ABI for ctypes (ray_tpu/_native/schedq.py); the
 // controller mirrors claims/releases so pool state here always matches its
 // dict accounting (asserted by the equivalence tests).
+//
+// `sq_schedule` extends the index into a full batched scheduling pass:
+// feasibility, idle-worker-class match, and resource claim for EVERY
+// dispatchable task run inside one ctypes call (one GIL release per
+// `_schedule` invocation) instead of one `sq_next` round-trip per dispatch.
 
 #include <cstdint>
 #include <cstring>
@@ -197,6 +202,78 @@ void sq_pop_task(void* h, int64_t task_seq) {
   for (auto dit = sig.fifo.begin(); dit != sig.fifo.end(); ++dit) {
     if (*dit == task_seq) { sig.fifo.erase(dit); break; }
   }
+}
+
+// Full scheduling pass, batched: one call per `_schedule` invocation picks
+// every dispatchable task, claims its resources, and debits the idle-worker
+// class it will run on — the controller then only applies the decisions
+// (worker pick + frame build) in Python.
+//
+//   sig_mode[i]   0 = skip (deferred/dead), 1 = plain task (needs an idle
+//                 worker in its bucket), 2 = python-handled barrier (actor
+//                 creation: pool-fit only; the pass STOPS when a mode-2
+//                 signature wins so Python can run the creation at exactly
+//                 the point the oracle loop would have).
+//   sig_bucket[i] index into bucket_idle for mode-1 sigs (idle-worker count
+//                 per (tpu_capable, env_key) class); -1 for mode-2.
+//   bucket_idle   in/out: decremented as decisions consume idle workers.
+//   out_seqs/out_sigs  decision arrays, capacity max_out.
+//   out_barrier   [sig, seq] of the winning mode-2 signature, else [-1,-1].
+//
+// Selection is byte-identical to the oracle loop: per iteration, among
+// eligible signatures that fit their pool (and, for mode 1, still have an
+// idle worker), the one with the smallest front sequence wins. Claims debit
+// the native pools; the controller applies the same debit to its dict pools
+// without re-mirroring.
+int64_t sq_schedule(void* h, const uint8_t* sig_mode, const int32_t* sig_bucket,
+                    int32_t n_sigs, int32_t* bucket_idle, int32_t n_buckets,
+                    int64_t* out_seqs, int32_t* out_sigs, int32_t max_out,
+                    int64_t* out_barrier) {
+  auto* q = static_cast<SchedQueue*>(h);
+  out_barrier[0] = -1;
+  out_barrier[1] = -1;
+  int32_t ns = static_cast<int32_t>(q->sigs.size());
+  if (n_sigs < ns) ns = n_sigs;
+  int64_t count = 0;
+  while (count < max_out) {
+    int64_t best_seq = -1;
+    int32_t best_sig = -1;
+    for (int32_t i = 0; i < ns; ++i) {
+      uint8_t mode = sig_mode[i];
+      if (!mode) continue;
+      Signature& sig = q->sigs[i];
+      drop_dead_front(q, sig);
+      if (sig.fifo.empty()) continue;
+      int64_t front = sig.fifo.front();
+      if (best_seq != -1 && front >= best_seq) continue;  // FIFO fairness
+      if (mode == 1) {
+        int32_t b = sig_bucket[i];
+        if (b < 0 || b >= n_buckets || bucket_idle[b] <= 0) continue;
+      }
+      auto pit = q->pools.find(sig.pool_id);
+      if (pit == q->pools.end() || !fits(pit->second, sig)) continue;
+      best_seq = front;
+      best_sig = i;
+    }
+    if (best_seq == -1) return count;
+    if (sig_mode[best_sig] == 2) {
+      out_barrier[0] = best_sig;
+      out_barrier[1] = best_seq;
+      return count;
+    }
+    Signature& sig = q->sigs[best_sig];
+    sig.fifo.pop_front();
+    q->tasks.erase(best_seq);
+    sig.live -= 1;
+    --q->pending;
+    Pool& p = q->pools[sig.pool_id];
+    for (const auto& [rid, amt] : sig.demand) p.avail[rid] -= amt;
+    bucket_idle[sig_bucket[best_sig]] -= 1;
+    out_seqs[count] = best_seq;
+    out_sigs[count] = best_sig;
+    ++count;
+  }
+  return count;
 }
 
 double sq_pool_avail(void* h, int64_t pool_id, int32_t rid) {

@@ -139,3 +139,82 @@ def test_missing_pool_never_fits_both_backends():
         seq, _ = q.next_dispatchable()
         assert seq == -1
     cq.close()
+
+
+def test_randomized_schedule_batch_equivalence():
+    """ISSUE 17: the BATCHED native pass (sq_schedule — feasibility,
+    idle-worker match, claim, all under one GIL release) against the Python
+    oracle. Seeded submit / complete / worker-death sequences must produce
+    identical decision lists, identical barrier points (mode-2 actor
+    creations), identical pool accounting, and identical pending counts."""
+    cq, pq = _pair()
+    rng = random.Random(17)
+    pools = {0: {"CPU": 8.0, "TPU": 2.0}, 1: {"CPU": 4.0}}
+    for q in (cq, pq):
+        for pid, avail in pools.items():
+            q.set_pool(pid, dict(avail))
+    # sig -> (id, pool, need, idle bucket, mode); one barrier signature
+    # (mode 2: actor creation the Python side must handle itself)
+    sigs = []
+    for k in range(8):
+        pool = rng.choice([0, 0, 1])
+        need = {"CPU": rng.choice([0.5, 1.0, 2.0])}
+        if pool == 0 and rng.random() < 0.4:
+            need["TPU"] = 1.0
+        cs = cq.register_sig(pool, need)
+        ps = pq.register_sig(pool, need)
+        assert cs == ps
+        sigs.append((cs, pool, need, pool, 2 if k == 5 else 1))
+    seq = 0
+    idle = [3, 2]
+    running = []  # (seq, sig index) holding a claim + a worker
+    for step in range(400):
+        r = rng.random()
+        if r < 0.45:
+            i = rng.randrange(len(sigs))
+            seq += 1
+            cq.push(seq, sigs[i][0])
+            pq.push(seq, sigs[i][0])
+        elif r < 0.60 and running:
+            # completion: claim released, the worker returns to its bucket
+            _s, i = running.pop(rng.randrange(len(running)))
+            _, pool, need, bucket, _ = sigs[i]
+            for q in (cq, pq):
+                q.adjust(pool, need, +1)
+            idle[bucket] += 1
+        elif r < 0.65:
+            # node death: pool 1 vanishes wholesale (its running tasks and
+            # idle workers die with it), then a replacement registers with
+            # full capacity — both backends see the identical sequence
+            for q in (cq, pq):
+                q.remove_pool(1)
+            running = [(s, i) for (s, i) in running if sigs[i][1] != 1]
+            idle[1] = 0
+            for q in (cq, pq):
+                q.set_pool(1, dict(pools[1]))
+            idle[1] = 2
+        else:
+            modes = [m for (_, _, _, _, m) in sigs]
+            buckets = [-1 if m == 2 else b for (_, _, _, b, m) in sigs]
+            got_c = cq.schedule_batch(modes, buckets, list(idle))
+            got_p = pq.schedule_batch(modes, buckets, list(idle))
+            assert got_c == got_p, (step, got_c, got_p)
+            decisions, bsig, bseq = got_c
+            for s, g in decisions:
+                idle[sigs[g][3]] -= 1
+                running.append((s, g))
+            if bsig != -1:
+                # the controller pops + claims barrier tasks in Python (a
+                # creation dispatches to a freshly spawned worker, so no
+                # idle decrement) — mirror that on both backends
+                _, pool, need, _bucket, _ = sigs[bsig]
+                for q in (cq, pq):
+                    q.pop_task(bseq)
+                    q.adjust(pool, need, -1)
+                running.append((bseq, bsig))
+        for pid in pools:
+            for res in ("CPU", "TPU"):
+                assert abs(cq.pool_avail(pid, res)
+                           - pq.pool_avail(pid, res)) < 1e-6, (step, pid, res)
+        assert cq.pending() == pq.pending(), step
+    cq.close()
