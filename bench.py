@@ -640,7 +640,9 @@ def orchestrate():
                 ("core_cp", "core_bench.py", 300, None),
                 ("transfer_dp", "transfer_bench.py", 300, None),
                 ("chain_dp", "chain_bench.py", 300, None),
-                ("pipeline_pp", "pipeline_bench.py", 600, None)):
+                ("pipeline_pp", "pipeline_bench.py", 600, None),
+                ("chaos_ladder", os.path.join("..", "tools",
+                                              "chaos_ladder.py"), 600, None)):
             result[key] = _run_aux_bench(script, tmo, extra)
             # re-emit the merged-so-far record (NOT a bare keyed line): the
             # last complete JSON line on stdout is always a full headline

@@ -1,0 +1,229 @@
+"""Deterministic fault-injection plane (chaos engineering harness).
+
+Reference: Ray's failure semantics are exercised by chaos tests that kill
+raylets and drop objects (python/ray/tests/test_chaos_cluster*); production
+practice (Chaos Mesh, Jepsen nemeses) injects faults at the transport and
+process layers. TPU-native cut: the injector lives INSIDE the processes it
+breaks — every injection point is a one-line hook at an existing seam
+(heartbeat send, data-plane range serve, object seal, node main) — and every
+decision is drawn from one seeded PRNG, so a failing chaos run replays
+bit-identically from its seed. Nothing here runs unless armed.
+
+Injection points (each a named Bernoulli draw + counter):
+
+  heartbeat_drop    black-hole a node's stats frame (silence → liveness sweep)
+  heartbeat_delay   sleep before each stats frame (lagging-node simulation)
+  sever_stream      close a data-plane range stream after a partial write
+                    (mid-pull failure → redistribution/backoff path)
+  drop_segment      delete a just-sealed shm segment (lost object → lineage)
+  kill_after        SIGKILL this process group N seconds after arming
+                    (node death → failover + reconstruction + reconciler)
+
+Env knobs (read once at first use; `configure()` / POST /api/chaos override
+at runtime for dev loops):
+
+  RAY_TPU_CHAOS                  "1" arms the injector (default off)
+  RAY_TPU_CHAOS_SEED             PRNG seed (default 0) — determinism anchor
+  RAY_TPU_CHAOS_HEARTBEAT_DROP   per-beat black-hole probability (0..1)
+  RAY_TPU_CHAOS_HEARTBEAT_DELAY_S  fixed delay before each stats frame
+  RAY_TPU_CHAOS_SEVER_STREAM     per-range-serve sever probability (0..1)
+  RAY_TPU_CHAOS_DROP_SEGMENT     per-seal segment-drop probability (0..1)
+  RAY_TPU_CHAOS_KILL_AFTER_S     SIGKILL own process group after N seconds
+
+The injector is process-local: arm it in a node agent's environment to break
+that node, in the head's to break the head. `/api/chaos` (dashboard.py) reads
+`snapshot()` and accepts `configure`/`kill_node`/`drop_object` ops so tests
+and benches can steer faults without restarts.
+"""
+
+import os
+import random
+import signal
+import threading
+import time
+from typing import Dict, Optional
+
+_POINTS = ("heartbeat_drop", "heartbeat_delay", "sever_stream",
+           "drop_segment", "kill_after")
+
+
+def _env_float(name: str, default: float = 0.0) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class ChaosInjector:
+    """Seeded fault injector. One instance per process (get_injector());
+    tests may construct their own with an explicit seed/config to assert
+    the deterministic draw sequence."""
+
+    def __init__(self, seed: Optional[int] = None,
+                 config: Optional[Dict[str, float]] = None):
+        self.armed = os.environ.get("RAY_TPU_CHAOS", "0") in ("1", "true")
+        if seed is None:
+            seed = int(_env_float("RAY_TPU_CHAOS_SEED", 0))
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.config: Dict[str, float] = {
+            "heartbeat_drop": _env_float("RAY_TPU_CHAOS_HEARTBEAT_DROP"),
+            "heartbeat_delay_s": _env_float("RAY_TPU_CHAOS_HEARTBEAT_DELAY_S"),
+            "sever_stream": _env_float("RAY_TPU_CHAOS_SEVER_STREAM"),
+            "drop_segment": _env_float("RAY_TPU_CHAOS_DROP_SEGMENT"),
+            "kill_after_s": _env_float("RAY_TPU_CHAOS_KILL_AFTER_S"),
+        }
+        if config:
+            self.config.update(config)
+        self.fired: Dict[str, int] = {p: 0 for p in _POINTS}
+        self.draws = 0
+        self._kill_timer: Optional[threading.Timer] = None
+        if self.armed and self.config["kill_after_s"] > 0:
+            self.arm_kill_timer(self.config["kill_after_s"])
+
+    # ------------------------------------------------------------- decisions
+    def should(self, point: str) -> bool:
+        """One deterministic Bernoulli draw for `point`. The draw is taken
+        even when the probability is 0 ONLY if the injector is armed, so the
+        sequence of decisions is a pure function of (seed, call order) —
+        replaying a failing run with the same seed and workload reproduces
+        the same fault schedule."""
+        if not self.armed:
+            return False
+        p = self.config.get(point, 0.0)
+        with self._lock:
+            self.draws += 1
+            hit = p > 0 and self._rng.random() < p
+            if hit:
+                self.fired[point] = self.fired.get(point, 0) + 1
+        if hit:
+            self._count(point)
+        return hit
+
+    def heartbeat_fault(self):
+        """(drop, delay_s) for one heartbeat: drop=True black-holes the
+        frame entirely; delay_s > 0 lags it (both exercise the head's
+        liveness sweep rather than the TCP-RST fast path)."""
+        drop = self.should("heartbeat_drop")
+        delay = 0.0
+        if self.armed and not drop and self.config["heartbeat_delay_s"] > 0:
+            delay = self.config["heartbeat_delay_s"]
+            with self._lock:
+                self.fired["heartbeat_delay"] += 1
+            self._count("heartbeat_delay")
+        return drop, delay
+
+    # --------------------------------------------------------------- actions
+    def maybe_drop_segment(self, controller, oid: str) -> bool:
+        """Armed-probability drop of a just-sealed shm segment: the meta
+        survives (location "shm") but the bytes are gone, so the next read
+        MISSes into `_descriptor`'s lost→lineage path — the seeded version
+        of test_lineage's `_zap`."""
+        if not self.should("drop_segment"):
+            return False
+        return self.drop_object(controller, oid)
+
+    @staticmethod
+    def drop_object(controller, oid: str) -> bool:
+        """Unconditionally delete `oid`'s local shm segment (the /api/chaos
+        `drop_object` op). Returns True if bytes were actually dropped."""
+        meta = controller.objects.get(oid)
+        if meta is None or meta.location != "shm":
+            return False
+        try:
+            if not controller.store.exists(oid):
+                return False  # bytes already gone (delete is idempotent)
+            controller.store.delete_segment(oid)
+        except Exception:  # noqa: BLE001 - already gone is fine
+            return False
+        return True
+
+    def arm_kill_timer(self, after_s: float):
+        """SIGKILL this process group `after_s` seconds from now — the
+        node-suicide knob (RAY_TPU_CHAOS_KILL_AFTER_S) a chaos harness sets
+        in a node agent's environment. SIGKILL (not SIGTERM): the point is
+        an unclean death the head must detect and recover from."""
+        if self._kill_timer is not None:
+            self._kill_timer.cancel()
+
+        def _die():
+            self._count("kill_after")
+            try:
+                os.killpg(os.getpgid(os.getpid()), signal.SIGKILL)
+            except OSError:
+                os.kill(os.getpid(), signal.SIGKILL)
+
+        self._kill_timer = threading.Timer(max(after_s, 0.0), _die)
+        self._kill_timer.daemon = True
+        self._kill_timer.start()
+
+    @staticmethod
+    def kill_node_pid(pid: int) -> bool:
+        """SIGKILL a node agent's process group by pid (the /api/chaos
+        `kill_node` op, resolved head-side from the registered node's pid)."""
+        try:
+            os.killpg(os.getpgid(pid), signal.SIGKILL)
+            return True
+        except OSError:
+            try:
+                os.kill(pid, signal.SIGKILL)
+                return True
+            except OSError:
+                return False
+
+    # -------------------------------------------------------------- controls
+    def configure(self, armed: Optional[bool] = None,
+                  seed: Optional[int] = None, **probs) -> Dict:
+        """Runtime reconfiguration (POST /api/chaos). Re-seeding resets the
+        draw sequence so a dev loop can replay a schedule exactly."""
+        if armed is not None:
+            self.armed = bool(armed)
+        if seed is not None:
+            self.seed = int(seed)
+            self._rng = random.Random(self.seed)
+            self.draws = 0
+        for k, v in probs.items():
+            if k in self.config:
+                self.config[k] = float(v)
+        if (self.armed and self.config["kill_after_s"] > 0
+                and ("kill_after_s" in probs or armed)):
+            self.arm_kill_timer(self.config["kill_after_s"])
+        return self.snapshot()
+
+    def snapshot(self) -> Dict:
+        return {"armed": self.armed, "seed": self.seed, "draws": self.draws,
+                "config": dict(self.config), "fired": dict(self.fired),
+                "ts": time.time()}
+
+    @staticmethod
+    def _count(point: str):
+        try:
+            from ..util import metrics
+            metrics.get_or_create(
+                metrics.Counter, "chaos_injections_total",
+                "faults injected by point", tag_keys=("point",)
+            ).inc(tags={"point": point})
+        except Exception:  # noqa: BLE001 - chaos must not need metrics
+            pass
+
+
+_injector: Optional[ChaosInjector] = None
+_injector_lock = threading.Lock()
+
+
+def get_injector() -> ChaosInjector:
+    global _injector
+    if _injector is None:
+        with _injector_lock:
+            if _injector is None:
+                _injector = ChaosInjector()
+    return _injector
+
+
+def enabled() -> bool:
+    """Cheap pre-check for hook sites: True only when the injector is (or
+    would be) armed — the common case never constructs the injector."""
+    if _injector is not None:
+        return _injector.armed
+    return os.environ.get("RAY_TPU_CHAOS", "0") in ("1", "true")

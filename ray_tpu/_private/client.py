@@ -136,7 +136,14 @@ class _OwnedTable:
     __slots__ = ("_lock", "_entries")
 
     def __init__(self):
-        self._lock = threading.Lock()
+        # Reentrant, like _DeltaFlusher's: allocations under the lock (the
+        # lazily-created waiter Event, refcount bumps) can trigger GC, and a
+        # collected ObjectRef's __del__ re-enters decref() on this same
+        # thread — a plain Lock self-deadlocks there. Reentrant mutation is
+        # safe: no method iterates _entries, and a nested decref can only
+        # drop entries whose last reference just died (never one a caller
+        # still holds a ref to).
+        self._lock = threading.RLock()
         self._entries = {}
 
     def add_resolved(self, oid, payload, meta_len, size):
@@ -709,6 +716,9 @@ class DriverClient(BaseClient):
     def state(self, kind):
         return self._call_soon(self.controller.state_snapshot, kind)
 
+    def chaos_op(self, op):
+        return self._call_soon(self.controller.chaos_op, op)
+
     def next_stream_item(self, task_id, index, timeout=None):
         return self._call(self.controller.next_stream_item(task_id, index, timeout))
 
@@ -1100,6 +1110,13 @@ class WorkerClient(BaseClient):
 
     def state(self, kind):
         return self._rpc("state", which=kind)["rows"]
+
+    def chaos_op(self, op):
+        p = self._rpc("chaos_op", chaos=op)
+        if "error" in p:
+            raise p["error"]
+        p.pop("req_id", None)
+        return p
 
     def timeline(self):
         return self._rpc("timeline")["events"]

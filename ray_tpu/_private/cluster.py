@@ -898,7 +898,7 @@ class ClusterServer:
               f"failing over {len(node.inflight)} tasks, "
               f"{len(node.actors)} actors", file=sys.stderr)
         try:
-            c.health.note_node_dead(node.node_id, node.host)
+            c.health.note_node_dead(node.node_id, node.host, pid=node.pid)
         except Exception:  # noqa: BLE001
             pass
         for tid, rec in list(node.inflight.items()):
@@ -937,8 +937,35 @@ class ClusterServer:
         # sharded sweep inside the directory instead of a pass over every
         # ObjectMeta building a holder list per object
         c.objdir.drop_node(node.node_id)
-        # objects whose only copy lived there are lost; lineage reconstructs
-        # on next access (meta stays, pull fails, _recover_object re-runs)
+        # EAGER location purge (not lazily at the next fetch): a replacement
+        # node re-registering on a recycled host:port must never find the
+        # dead id still authoritative for an object, and every object whose
+        # only copy died is either promoted to a surviving holder or handed
+        # to lineage recovery right now
+        dead_loc = f"remote:{node.node_id}"
+        lost = []
+        for oid, meta in list(c.objects.items()):
+            if meta.location != dead_loc:
+                continue
+            survivors = []
+            for h in meta.holders:
+                n = self.nodes.get(h)
+                if h != node.node_id and n is not None and n.alive:
+                    survivors.append(h)
+            if survivors:
+                # an extra holder becomes the authoritative copy; pulls and
+                # _collect_deps redirects keep working without a reconstruct
+                meta.location = f"remote:{survivors[0]}"
+                meta.holders = survivors[1:]
+            else:
+                lost.append(oid)
+        if lost:
+            from .controller import reconstruct_enabled
+            if reconstruct_enabled():
+                c.loop.create_task(c._recover_lost_objects(
+                    lost, node.node_id, node.last_seen, time.time()))
+            # else: losses surface lazily (meta stays remote:<dead>, the
+            # pull fails, _descriptor → _recover_object at the next get())
         c._schedule()
 
     # --------------------------------------------------------------- surface

@@ -98,6 +98,24 @@ def prefetch_max_bytes() -> int:
         return 256 << 20
 
 
+def reconstruct_enabled() -> bool:
+    """Eager node-death object recovery (ref: object_recovery_manager.cc
+    driven from the GCS node-failure publisher). When a node dies, objects
+    whose only copy lived there re-enqueue their creating tasks from lineage
+    immediately. RAY_TPU_RECONSTRUCT=0 is the escape hatch: losses then
+    surface lazily at the next get()/pull (old behavior)."""
+    return os.environ.get("RAY_TPU_RECONSTRUCT", "1").lower() not in (
+        "0", "false", "no")
+
+
+def autoscale_enabled() -> bool:
+    """Alert-driven reconciler loop (autoscaler/reconciler.py): node_dead /
+    store-pressure / queue-growth alerts drive the installed NodeProvider.
+    RAY_TPU_AUTOSCALE=0 disables the loop (manual provisioning only)."""
+    return os.environ.get("RAY_TPU_AUTOSCALE", "1").lower() not in (
+        "0", "false", "no")
+
+
 @dataclass
 class TaskRecord:
     spec: TaskSpec
@@ -538,6 +556,9 @@ class Controller:
         self.provider_max_nodes = 0
         # handle -> promised resources ({"CPU": c, "num_tpus": t})
         self._provider_nodes: Dict[str, Dict[str, float]] = {}
+        # alert-driven reconciler (autoscaler/reconciler.py), built by
+        # set_node_provider; ticked from _reaper next to health.tick()
+        self.reconciler = None
         # env keys with an async build in flight (built off-loop: a pip venv
         # install can take minutes and must not freeze the controller)
         self._env_building: Set[str] = set()
@@ -685,6 +706,11 @@ class Controller:
                 self.health.tick()
             except Exception:  # noqa: BLE001 - health must not kill the reaper
                 pass
+            if self.reconciler is not None:
+                try:
+                    self.reconciler.tick()
+                except Exception:  # noqa: BLE001 - ditto for the reconciler
+                    pass
             self._schedule()
 
     # ------------------------------------------------------- worker connection
@@ -828,6 +854,11 @@ class Controller:
                         **self.request_resources(p.get("num_cpus"), p.get("bundles")))
         elif kind == "autoscaler_status":
             self._reply(w, p["req_id"], **self.autoscaler_status())
+        elif kind == "chaos_op":
+            try:
+                self._reply(w, p["req_id"], **self.chaos_op(p.get("chaos") or {}))
+            except ValueError as e:
+                self._reply(w, p["req_id"], error=e)
         elif kind == "actor_exit":
             # graceful exit_actor(): mark dead without restart
             actor = self.actors.get(p["actor_id"])
@@ -1719,6 +1750,14 @@ class Controller:
                              "init(cluster_port=...) first")
         self.node_provider = provider
         self.provider_max_nodes = max_nodes
+        # installing a provider arms the alert-driven reaction loop (dead
+        # node replacement, pressure scale-up); RAY_TPU_AUTOSCALE=0 keeps
+        # provisioning strictly manual
+        if autoscale_enabled():
+            from ..autoscaler.reconciler import Reconciler
+            self.reconciler = Reconciler(self)
+        else:
+            self.reconciler = None
 
     def autoscaler_status(self) -> dict:
         workers = list(self.workers.values()) + list(self.spawning.values())
@@ -1736,7 +1775,39 @@ class Controller:
         if self.cluster is not None:
             out["nodes"] = len(self.cluster.nodes) + 1
             out["provider_nodes"] = list(self._provider_nodes)
+        if self.reconciler is not None:
+            out["reconciler"] = self.reconciler.status()
         return out
+
+    def chaos_op(self, op: dict) -> dict:
+        """Dev chaos surface behind /api/chaos (see _private/chaos.py).
+        Ops: snapshot (default — injector state + live node pid map),
+        configure (arm/seed/probabilities at runtime), drop_object (delete
+        a head-local shm segment → lineage path), kill_node (SIGKILL a
+        registered node agent's process group by node_id → death path)."""
+        from . import chaos as _chaos
+        what = op.get("op", "snapshot")
+        if what == "snapshot":
+            out = _chaos.get_injector().snapshot()
+            out["nodes"] = (
+                {n.node_id: n.pid for n in self.cluster.nodes.values()
+                 if n.alive}
+                if self.cluster is not None else {})
+            return out
+        if what == "configure":
+            kw = {k: v for k, v in op.items() if k != "op"}
+            return _chaos.get_injector().configure(**kw)
+        if what == "drop_object":
+            return {"dropped": _chaos.ChaosInjector.drop_object(
+                self, op.get("oid", ""))}
+        if what == "kill_node":
+            node = (self.cluster.nodes.get(op.get("node_id"))
+                    if self.cluster is not None else None)
+            if node is None or not node.pid:
+                return {"killed": False, "error": "unknown node"}
+            return {"killed": _chaos.ChaosInjector.kill_node_pid(node.pid),
+                    "pid": node.pid}
+        raise ValueError(f"unknown chaos op {what!r}")
 
     # ------------------------------------------------- health signal plane
     def health_snapshot(self) -> dict:
@@ -2216,6 +2287,11 @@ class Controller:
             meta.location = "shm"
             self.store_used += size
             self._maybe_spill()
+            from . import chaos as _chaos
+            if _chaos.enabled():
+                # seeded drop-a-just-sealed-segment fault: bytes vanish, the
+                # meta survives, the next read MISSes into lineage recovery
+                _chaos.get_injector().maybe_drop_segment(self, oid)
         if meta.owner is not None and meta.owner != owner:
             # sealed by someone other than its owner: push the descriptor
             # home. Inline bytes ship whole; shm-backed results fall back to
@@ -2537,14 +2613,35 @@ class Controller:
                 self._enqueue_ready(rec)
         self._schedule()
 
+    def _spill_protected(self) -> set:
+        """Oids the spiller must leave alone beyond the pin count: objects a
+        pull manager is landing or has committed to land (the pin brackets
+        the transfer, but a spill racing the park→launch gap would evict the
+        segment out from under the admission queue), and prefetched objects
+        whose dispatch gate hasn't attached yet (pin released at ingest,
+        descriptor claimed at dispatch — spilling in between turns the
+        prefetch win into a restore)."""
+        out = set()
+        if self.prefetch is not None:
+            out |= self.prefetch.protected()
+        agent = getattr(self, "agent", None)  # node controllers: the
+        if agent is not None:                 # redirected-dep pull manager
+            pm = agent._pull_manager
+            if pm is not None:
+                out |= pm.protected()
+        return out
+
     def _maybe_spill(self):
         """Spill oldest unpinned shm objects when over capacity (ref: plasma
         eviction + object spilling, src/ray/object_manager/spilled_object)."""
         if self.store_used <= self.store_capacity:
             return
+        protected = self._spill_protected()
         for oid, meta in list(self.objects.items()):
             if self.store_used <= self.store_capacity * 0.8:
                 break
+            if oid in protected or meta.prefetched:
+                continue
             if meta.location == "shm" and meta.pinned == 0:
                 try:
                     meta.spill_path = self.store.spill(oid)
@@ -2896,6 +2993,7 @@ class Controller:
                 continue
             arg_meta = self.objects.get(v)
             arg_lost = (arg_meta is None or
+                        self._remote_holder_dead(arg_meta) or
                         (arg_meta.location == "shm"
                          and not self.store.exists(v)))
             if arg_lost and not await self._recover_object(v):
@@ -2917,6 +3015,71 @@ class Controller:
             self._enqueue_ready(fresh)
         self._schedule()
         return True
+
+    def _remote_holder_dead(self, meta: ObjectMeta) -> bool:
+        """True when an object's bytes live only on dead nodes: the
+        authoritative remote location's node is gone AND no surviving holder
+        has a copy. The recursive lineage walk treats such args as lost
+        (same as a vanished shm segment) instead of queueing a pull that can
+        only time out against a corpse."""
+        if self.cluster is None or not meta.location.startswith("remote:"):
+            return False
+        node = self.cluster.nodes.get(meta.location.split(":", 1)[1])
+        if node is not None and node.alive:
+            return False
+        for h in meta.holders:
+            n = self.cluster.nodes.get(h)
+            if n is not None and n.alive:
+                return False
+        return True
+
+    async def _recover_lost_objects(self, oids: List[str], node_id: str,
+                                    t_seen: float, t_detect: float):
+        """Eager recovery sweep after a node death (cluster._on_node_dead):
+        re-enqueue the creating task of every object whose only copy died
+        with the node. Objects with no usable lineage (actor/stream outputs,
+        exhausted reconstruction budget) resolve to ObjectLostError NOW so
+        waiters fail fast instead of timing out. Trace windows land in the
+        head timeline (`recover.detect` = last heartbeat → detection,
+        `recover.reconstruct` = the sweep itself) so `python -m ray_tpu
+        timeline` attributes recovery cost per phase."""
+        from ..util import metrics
+        t0 = time.time()
+        tracing.record_window("recover.detect", "recovery", None,
+                              t_seen, t_detect,
+                              args={"node_id": node_id, "objects": len(oids)})
+        recovered = 0
+        for oid in oids:
+            ok = False
+            try:
+                ok = await self._recover_object(oid)
+            except Exception:  # noqa: BLE001 - recovery must sweep every oid
+                ok = False
+            if ok:
+                recovered += 1
+                continue
+            meta = self.objects.get(oid)
+            if meta is not None and meta.location != "error" and not (
+                    meta.location in ("shm", "inline", "spilled")):
+                meta.error = exc.ObjectLostError(oid)
+                meta.location = "error"
+            ev = self.object_events.get(oid)
+            if ev is not None:
+                ev.set()
+            self._resolve_dep(oid)
+        metrics.get_or_create(
+            metrics.Counter, "reconstructions_total",
+            "lineage reconstructions started after node death").inc(recovered)
+        if recovered < len(oids):
+            metrics.get_or_create(
+                metrics.Counter, "reconstruction_failures_total",
+                "objects resolved to ObjectLostError after node death"
+            ).inc(len(oids) - recovered)
+        tracing.record_window("recover.reconstruct", "recovery", None,
+                              t0, time.time(),
+                              args={"node_id": node_id, "lost": len(oids),
+                                    "reconstructing": recovered})
+        self._schedule()
 
     # ---------------------------------------------------------------- streaming
     def _on_stream_item(self, p: dict):

@@ -188,12 +188,15 @@ class HealthMonitor:
                    severity="critical", silence_s=round(silence_s, 1))
 
     def note_node_dead(self, node_id: str, host: str = "",
-                       reason: str = "disconnected") -> None:
+                       reason: str = "disconnected", pid: int = 0) -> None:
+        # pid rides the tombstone AND the alert data so the reconciler can
+        # match the dead agent to a provider launch handle (pid_of)
         self.dead_nodes[node_id] = {
             "node_id": node_id, "is_head": False, "alive": False,
-            "host": host, "dead_since": self.clock(), "reason": reason}
+            "host": host, "dead_since": self.clock(), "reason": reason,
+            "pid": pid}
         self._fire("node_dead", node_id, f"node {node_id} {reason}",
-                   severity="critical", host=host, reason=reason)
+                   severity="critical", host=host, reason=reason, pid=pid)
 
     # -- internals ----------------------------------------------------------
     def _fire(self, kind, key, message, severity="warning", **data):

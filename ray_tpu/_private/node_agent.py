@@ -35,12 +35,13 @@ import os
 import socket as _socket
 import sys
 import time
+import zlib
 from typing import Dict, Optional
 
 from .. import exceptions as exc
 from .._native import codec as _codec
 from ..util import tracing
-from . import ids, paths, protocol
+from . import chaos, ids, paths, protocol
 from .cluster import HEARTBEAT_S, cluster_token
 from .controller import (Controller, DEFAULT_CAPACITY, format_timeline,
                          prefetch_max_bytes)
@@ -209,6 +210,29 @@ def transfer_streams() -> int:
         return 4
 
 
+def transfer_deadline_s() -> float:
+    """Hard wall-clock budget for one object transfer, retries included
+    (RAY_TPU_TRANSFER_DEADLINE_S, default 30). Past it the pull aborts and
+    fails over — to another holder set, the head-staged path, or lineage
+    reconstruction — rather than retrying forever against a dead peer."""
+    try:
+        return max(1.0,
+                   float(os.environ.get("RAY_TPU_TRANSFER_DEADLINE_S", "30")))
+    except ValueError:
+        return 30.0
+
+
+def retry_backoff_s(attempt: int, key: str = "",
+                    base: float = 0.05, cap: float = 2.0) -> float:
+    """Bounded exponential backoff with DETERMINISTIC jitter: the jitter
+    factor (0.5..1.0) hashes (key, attempt) instead of sampling a PRNG, so
+    a chaos replay reproduces the exact same retry schedule (ref: Ray's
+    ExponentialBackOff in src/ray/util; AWS full-jitter, made replayable)."""
+    delay = min(cap, base * (2 ** max(0, attempt)))
+    j = zlib.crc32(f"{key}:{attempt}".encode()) % 1000 / 1000.0
+    return delay * (0.5 + 0.5 * j)
+
+
 def use_parallel_transfer() -> bool:
     """False pins the r5 single-stream sync path (RAY_TPU_TRANSFER_SYNC=1,
     or RAY_TPU_TRANSFER_STREAMS=1) — the escape hatch when a peer can't
@@ -230,6 +254,8 @@ def _record_transfer(nbytes: int, nstreams: int, seconds: float,
     if retries:
         metrics.get_or_create(metrics.Counter,
                               "transfer_stream_retries").inc(retries)
+        metrics.get_or_create(metrics.Counter,
+                              "transfer_retries_total").inc(retries)
     metrics.get_or_create(metrics.Histogram, "transfer_fetch_seconds",
                           boundaries=[0.001, 0.01, 0.1, 1, 10, 100]
                           ).observe(seconds)
@@ -328,6 +354,14 @@ class PullManager:
         t = self.loop.create_task(run())
         self._inflight[oid] = t
         return t
+
+    def protected(self) -> set:
+        """Oids this manager is landing (in-flight) or has committed to land
+        (parked over the byte cap). The spiller must never touch these: an
+        in-flight pull's segment is pinned, but a spill racing the park→launch
+        gap — or evicting the segment a just-completed pull's dispatch gate
+        is about to attach — would turn one transfer into two."""
+        return set(self._inflight) | set(self._queued)
 
     def _drain(self):
         while self._waiting:
@@ -434,10 +468,16 @@ class ObjectDataServer:
             writer.write(b"MISS\n")
             await writer.drain()
             return
+        sever_at = -1
+        if chaos.enabled() and chaos.get_injector().should("sever_stream"):
+            sever_at = len(blob) // 2
         head = (f"OK {len(blob)} {meta.meta_len}\n"
                 f"{' '.join(meta.contained)}\n").encode("ascii")
         writer.write(head)
         for i in range(0, len(blob), _DATA_CHUNK):
+            if 0 <= sever_at <= i:
+                writer.close()
+                return
             writer.write(blob[i:i + _DATA_CHUNK])
             await writer.drain()  # backpressure per chunk
         self.serve_bytes += len(blob)
@@ -458,8 +498,15 @@ class ObjectDataServer:
             writer.write(b"MISS\n")
             await writer.drain()
             return
+        sever_at = -1
+        if chaos.enabled() and chaos.get_injector().should("sever_stream"):
+            sever_at = len(blob) // 2  # partial write, then hang up: the
+            # puller sees a short range and redistributes/backs off
         writer.write(f"OK {len(blob)}\n".encode("ascii"))
         for i in range(0, len(blob), _DATA_CHUNK):
+            if 0 <= sever_at <= i:
+                writer.close()
+                return
             writer.write(blob[i:i + _DATA_CHUNK])
             await writer.drain()  # backpressure per chunk
         self.serve_bytes += len(blob)
@@ -581,8 +628,10 @@ async def parallel_fetch(addrs, oid: str, size: int, meta_len: int,
     streams_opened = 0
     retries = 0
     ok = False
+    deadline = t0 + min(timeout, transfer_deadline_s())
     try:
-        for _round in range(3):
+        _round = 0
+        while True:
             streams_opened += len(ranges)
             if _round:
                 retries += len(ranges)
@@ -595,6 +644,17 @@ async def parallel_fetch(addrs, oid: str, size: int, meta_len: int,
             if not leftover:
                 ok = True
                 break
+            # bounded exponential backoff under a hard deadline (replaces
+            # the old fixed 3-round cap): a flapping peer gets breathing
+            # room, a dead one stops eating streams once the budget is spent
+            _round += 1
+            pause = retry_backoff_s(_round, key=oid)
+            if time.monotonic() + pause >= deadline:
+                from ..util import metrics
+                metrics.get_or_create(
+                    metrics.Counter, "transfer_deadline_exceeded_total").inc()
+                break
+            await asyncio.sleep(pause)
             # redistribute dead streams' tails to the OTHER holders; with a
             # single holder, retry it (covers transient mid-transfer resets)
             ranges = []
@@ -608,6 +668,10 @@ async def parallel_fetch(addrs, oid: str, size: int, meta_len: int,
         else:
             handle.abort()
     if not ok:
+        if retries:
+            from ..util import metrics
+            metrics.get_or_create(metrics.Counter,
+                                  "transfer_retries_total").inc(retries)
         return None
     _record_transfer(size, streams_opened, time.monotonic() - t0,
                      retries=retries)
@@ -700,6 +764,13 @@ class NodeAgent:
     async def _heartbeat(self):
         while not self.c._shutdown:
             await asyncio.sleep(HEARTBEAT_S)
+            if chaos.enabled():
+                drop, delay = chaos.get_injector().heartbeat_fault()
+                if drop:
+                    continue  # black-holed beat: head's liveness sweep sees
+                              # silence while the TCP link stays up
+                if delay:
+                    await asyncio.sleep(delay)
             try:
                 # span shipping piggybacks on the heartbeat: drain this
                 # node's traced phase spans (node-id-stamped pid groups
@@ -1078,6 +1149,10 @@ async def _amain(args) -> int:
         resources[k] = float(v)
     controller = NodeController(sock, resources, job_id=ids.job_id(),
                                 store_capacity=store_bytes)
+    if chaos.enabled():
+        # constructing the injector arms RAY_TPU_CHAOS_KILL_AFTER_S (node
+        # suicide-by-SIGKILL after N seconds — the chaos ladder's main rung)
+        chaos.get_injector()
     await controller.start()
     agent = NodeAgent(controller, args.address)
     try:

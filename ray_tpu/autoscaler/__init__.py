@@ -15,6 +15,8 @@ from ray_tpu.autoscaler import sdk
 from ray_tpu.autoscaler.gcp_tpu import (FakeTpuApi, GcloudTpuApi,
                                         GcpTpuNodeProvider, slice_info)
 from ray_tpu.autoscaler.node_provider import NodeProvider, SubprocessNodeProvider
+from ray_tpu.autoscaler.reconciler import Reconciler
 
 __all__ = ["sdk", "NodeProvider", "SubprocessNodeProvider",
-           "GcpTpuNodeProvider", "GcloudTpuApi", "FakeTpuApi", "slice_info"]
+           "GcpTpuNodeProvider", "GcloudTpuApi", "FakeTpuApi", "slice_info",
+           "Reconciler"]

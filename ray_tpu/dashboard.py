@@ -110,6 +110,19 @@ class DashboardActor:
             return _coerce_response(client.state("cluster_health"))
         if path == "/api/alerts":
             return _coerce_response(client.state("alerts"))
+        if path == "/api/chaos":
+            # dev fault-injection surface (_private/chaos.py): GET = head
+            # injector snapshot + live node pid map; POST = {"op": ...}
+            # (configure / drop_object / kill_node) executed at the head
+            if req.method == "POST":
+                try:
+                    op = req.json() or {}
+                except json.JSONDecodeError as e:
+                    return Response(
+                        json.dumps({"error": f"invalid JSON body: {e}"}).encode(),
+                        400)
+                return _coerce_response(client.chaos_op(op))
+            return _coerce_response(client.chaos_op({"op": "snapshot"}))
         if path == "/api/_boom":
             # test hook: exercises the JSON-500 error path end to end
             raise RuntimeError("boom (dashboard 500 test hook)")

@@ -249,8 +249,10 @@ def _counter_total(name: str) -> float:
 
 def transfer_counters() -> Dict[str, float]:
     """Per-process parallel-transfer tallies: fetches completed, bytes
-    landed, streams opened, stream retries (redistributed tails), and total
-    seconds spent transferring."""
+    landed, streams opened, stream retries (redistributed tails), retry
+    rounds across successful AND abandoned fetches (retries_total),
+    transfers that ran out their hard deadline (deadline_exceeded), and
+    total seconds spent transferring."""
     with _registry_lock:
         hist = _registry.get("transfer_fetch_seconds")
     seconds = 0.0
@@ -260,6 +262,9 @@ def transfer_counters() -> Dict[str, float]:
             "bytes": _counter_total("transfer_fetch_bytes"),
             "streams": _counter_total("transfer_fetch_streams"),
             "retries": _counter_total("transfer_stream_retries"),
+            "retries_total": _counter_total("transfer_retries_total"),
+            "deadline_exceeded":
+                _counter_total("transfer_deadline_exceeded_total"),
             "seconds": seconds}
 
 

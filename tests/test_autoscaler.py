@@ -1,8 +1,13 @@
 """Autoscaler hooks (ref: python/ray/autoscaler/sdk.py request_resources):
 explicit demand warms the worker pool; requests overwrite; infeasible
-requests are clamped and reported, not silently dropped."""
+requests are clamped and reported, not silently dropped.
+
+Second half: the alert-driven Reconciler (ref: python/ray/autoscaler/
+_private/autoscaler.py StandardAutoscaler update loop), driven entirely
+with fakes and a fake clock — no cluster, no subprocesses, no sleeps."""
 
 import time
+from types import SimpleNamespace
 
 
 def test_request_resources_warms_pool(ray_session):
@@ -49,3 +54,219 @@ def test_request_resources_bundles(ray_session):
     res = sdk.request_resources(bundles=[{"CPU": 1}, {"CPU": 2}])
     assert res["target_cpus"] == 3
     sdk.request_resources()  # clear
+
+
+# --------------------------------------------------------------- reconciler
+class _FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class _FakeProvider:
+    """Records create/terminate calls and hands out deterministic pids."""
+
+    cpus_per_node = 2.0
+
+    def __init__(self):
+        self.created = []
+        self.terminated = []
+        self._pids = {}
+        self._n = 0
+
+    def create_node(self, resources, address):
+        self._n += 1
+        h = f"fake-node-{self._n}"
+        self._pids[h] = 10000 + self._n
+        self.created.append(h)
+        return h
+
+    def terminate_node(self, h):
+        self.terminated.append(h)
+        self._pids.pop(h, None)
+
+    def non_terminated_nodes(self):
+        return list(self._pids)
+
+    def pid_of(self, h):
+        return self._pids.get(h)
+
+
+def _fake_head(clock, max_nodes=4):
+    """Narrow controller surface the Reconciler is written against."""
+    from ray_tpu._private.health import HealthMonitor
+
+    c = SimpleNamespace(
+        node_id="node-head",
+        node_provider=_FakeProvider(),
+        provider_max_nodes=max_nodes,
+        _provider_nodes={},
+        cluster=SimpleNamespace(nodes={}, address="127.0.0.1:7777"),
+        ready_queue=[])
+    c.health = HealthMonitor(c, clock=clock)
+    return c
+
+
+def _register_node(c, node_id, pid):
+    c.cluster.nodes[node_id] = SimpleNamespace(
+        node_id=node_id, pid=pid, alive=True, inflight={}, actors=set())
+    c.health.note_node_alive(node_id)
+
+
+def _launch_provider_node(c, node_id):
+    """Simulate a prior provider launch whose agent is registered+alive."""
+    h = c.node_provider.create_node({"CPU": 2.0}, c.cluster.address)
+    c._provider_nodes[h] = {"CPU": 2.0}
+    _register_node(c, node_id, c.node_provider.pid_of(h))
+    return h
+
+
+def test_reconciler_replaces_dead_node_with_causality():
+    """node_dead alert -> terminate the dead handle, launch a replacement,
+    and record the alert-id -> create_node causality; the pending launch
+    closes to `recovered` when the replacement's pid registers; the same
+    alert is never consumed twice (cursor)."""
+    from ray_tpu.autoscaler.reconciler import Reconciler
+
+    clock = _FakeClock()
+    c = _fake_head(clock)
+    rec = Reconciler(c, clock=clock)
+    h1 = _launch_provider_node(c, "node-a")
+    dead_pid = c.node_provider.pid_of(h1)
+    rec.tick()  # steady state: nothing to do
+    assert c.node_provider.terminated == [] and rec.replacements == 0
+
+    # the node dies: cluster marks it dead and fires the alert (the same
+    # path ClusterServer._on_node_dead drives)
+    clock.advance(1.0)
+    c.cluster.nodes["node-a"].alive = False
+    c.health.note_node_dead("node-a", host="127.0.0.1", pid=dead_pid)
+    clock.advance(0.5)
+    rec.tick()
+
+    assert c.node_provider.terminated == [h1]
+    assert rec.replacements == 1
+    assert h1 not in c._provider_nodes
+    h2 = c.node_provider.created[-1]
+    assert h2 != h1 and h2 in c._provider_nodes and h2 in rec._pending
+
+    alert = c.health.alerts.events()[-1]
+    assert alert["kind"] == "node_dead" and alert["data"]["pid"] == dead_pid
+    actions = [(e["action"], e["handle"], e["alert_id"]) for e in rec.events]
+    assert ("terminate_dead", h1, alert["id"]) in actions
+    assert ("replace", h2, alert["id"]) in actions
+
+    # replacement registers -> pending closes with a `recovered` record
+    clock.advance(2.0)
+    _register_node(c, "node-b", c.node_provider.pid_of(h2))
+    rec.tick()
+    assert rec._pending == {}
+    recovered = [e for e in rec.events if e["action"] == "recovered"]
+    assert recovered and recovered[-1]["handle"] == h2
+    assert recovered[-1]["alert_id"] == alert["id"]
+
+    # cursor: re-ticking the same log must not double-replace
+    rec.tick()
+    assert rec.replacements == 1 and len(c.node_provider.created) == 2
+
+    st = rec.status()
+    assert st["replacements"] == 1 and st["cursor"] == alert["id"]
+
+
+def test_reconciler_replace_clamped_at_max_nodes():
+    """A death the provider can't absorb (slot cap, dead node wasn't a
+    provider launch) records replace_clamped instead of over-launching."""
+    from ray_tpu.autoscaler.reconciler import Reconciler
+
+    clock = _FakeClock()
+    c = _fake_head(clock, max_nodes=1)
+    rec = Reconciler(c, clock=clock)
+    _launch_provider_node(c, "node-a")  # fills the only slot
+    _register_node(c, "node-x", pid=4242)  # manually-started node
+
+    c.cluster.nodes["node-x"].alive = False
+    c.health.note_node_dead("node-x", pid=4242)
+    rec.tick()
+
+    assert rec.replacements == 0
+    assert len(c.node_provider.created) == 1  # no new launch
+    assert c.node_provider.terminated == []   # alive handle untouched
+    assert any(e["action"] == "replace_clamped" for e in rec.events)
+
+
+def test_reconciler_pressure_scale_up_with_cooldown():
+    """store_pressure / queue_growth alerts scale up one node, then the
+    cooldown suppresses the next pressure alert until it expires."""
+    from ray_tpu.autoscaler.reconciler import Reconciler
+
+    clock = _FakeClock()
+    c = _fake_head(clock)
+    rec = Reconciler(c, clock=clock)
+
+    c.health.alerts.fire("store_pressure", "node-head", "store 93% full")
+    rec.tick()
+    assert rec.scale_ups == 1 and len(c.node_provider.created) == 1
+
+    # second pressure signal inside the cooldown window: suppressed
+    clock.advance(1.0)
+    c.health.alerts.fire("queue_growth", "node-head", "queue growing")
+    rec.tick()
+    assert rec.scale_ups == 1
+    assert any(e["action"] == "scale_up_suppressed" for e in rec.events)
+
+    # cooldown expires; a fresh alert scales up again
+    clock.advance(15.0)
+    c.health.alerts.resolve("queue_growth", "node-head")
+    c.health.alerts.fire("queue_growth", "node-head", "queue growing again")
+    rec.tick()
+    assert rec.scale_ups == 2 and len(c.node_provider.created) == 2
+
+
+def test_reconciler_idle_scale_down():
+    """An idle cluster (empty ready queue, no active alerts, no pending
+    launches) sheds ONE idle provider node after the idle window; busy
+    signals re-arm the timer."""
+    from ray_tpu.autoscaler.reconciler import Reconciler
+
+    clock = _FakeClock()
+    c = _fake_head(clock)
+    rec = Reconciler(c, clock=clock)
+    h1 = _launch_provider_node(c, "node-a")
+
+    c.ready_queue.append(object())  # busy: timer must not arm
+    rec.tick()
+    c.ready_queue.clear()
+    rec.tick()               # idle period starts NOW
+    clock.advance(30.0)
+    rec.tick()               # not idle long enough
+    assert rec.scale_downs == 0 and c.node_provider.terminated == []
+
+    clock.advance(45.0)      # total idle 75s > 60s default
+    rec.tick()
+    assert rec.scale_downs == 1 and c.node_provider.terminated == [h1]
+    assert h1 not in c._provider_nodes
+    assert any(e["action"] == "scale_down" for e in rec.events)
+
+    # a node with work in flight is never the scale-down victim
+    h2 = _launch_provider_node(c, "node-b")
+    c.cluster.nodes["node-b"].inflight["t1"] = object()
+    rec.tick()
+    clock.advance(120.0)
+    rec.tick()
+    assert c.node_provider.terminated == [h1] and h2 in c._provider_nodes
+
+
+def test_autoscale_enabled_knob(monkeypatch):
+    from ray_tpu._private.controller import autoscale_enabled
+
+    monkeypatch.delenv("RAY_TPU_AUTOSCALE", raising=False)
+    assert autoscale_enabled() is True
+    monkeypatch.setenv("RAY_TPU_AUTOSCALE", "0")
+    assert autoscale_enabled() is False
+    monkeypatch.setenv("RAY_TPU_AUTOSCALE", "1")
+    assert autoscale_enabled() is True

@@ -1,0 +1,134 @@
+"""Fault-injection plane unit tests: the chaos injector's determinism
+contract (same seed + same call order => same fault schedule), knob
+parsing, runtime reconfiguration, and the retry-backoff/deadline helpers
+the transfer plane retries with. No cluster needed — the injector is
+process-local by design (ref: python/ray/tests/test_chaos_cluster*)."""
+
+import numpy as np
+import pytest
+
+from ray_tpu._private.chaos import ChaosInjector, enabled
+
+
+def _armed(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CHAOS", "1")
+
+
+def test_deterministic_draw_sequence(monkeypatch):
+    """Two injectors with the same seed and config make the identical
+    decision sequence — the replay anchor for failing chaos runs."""
+    _armed(monkeypatch)
+    cfg = {"sever_stream": 0.3, "drop_segment": 0.5}
+    a = ChaosInjector(seed=42, config=cfg)
+    b = ChaosInjector(seed=42, config=cfg)
+    schedule = [("sever_stream" if i % 2 else "drop_segment") for i in range(40)]
+    assert [a.should(p) for p in schedule] == [b.should(p) for p in schedule]
+    assert a.draws == b.draws == 40
+    assert a.fired == b.fired
+    assert sum(a.fired.values()) > 0  # with p=0.3/0.5 over 40 draws
+
+    # a DIFFERENT seed gives a different schedule (overwhelmingly)
+    c = ChaosInjector(seed=43, config=cfg)
+    assert [c.should(p) for p in schedule] != [a.should(p) for p in schedule] \
+        or c.fired != a.fired
+
+
+def test_unarmed_injector_is_inert(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_CHAOS", "0")
+    inj = ChaosInjector(seed=1, config={"sever_stream": 1.0,
+                                        "heartbeat_drop": 1.0,
+                                        "heartbeat_delay_s": 5.0})
+    assert not enabled()
+    assert inj.should("sever_stream") is False
+    assert inj.heartbeat_fault() == (False, 0.0)
+    assert inj.draws == 0  # unarmed paths never consume the PRNG
+
+
+def test_env_knob_parsing(monkeypatch):
+    _armed(monkeypatch)
+    monkeypatch.setenv("RAY_TPU_CHAOS_SEED", "7")
+    monkeypatch.setenv("RAY_TPU_CHAOS_HEARTBEAT_DROP", "0.25")
+    monkeypatch.setenv("RAY_TPU_CHAOS_HEARTBEAT_DELAY_S", "1.5")
+    monkeypatch.setenv("RAY_TPU_CHAOS_SEVER_STREAM", "bogus")  # -> default 0
+    inj = ChaosInjector()
+    assert inj.armed and inj.seed == 7
+    assert inj.config["heartbeat_drop"] == 0.25
+    assert inj.config["heartbeat_delay_s"] == 1.5
+    assert inj.config["sever_stream"] == 0.0
+
+
+def test_heartbeat_fault_drop_and_delay(monkeypatch):
+    _armed(monkeypatch)
+    inj = ChaosInjector(seed=0, config={"heartbeat_drop": 1.0})
+    assert inj.heartbeat_fault() == (True, 0.0)
+    inj2 = ChaosInjector(seed=0, config={"heartbeat_delay_s": 0.75})
+    assert inj2.heartbeat_fault() == (False, 0.75)
+    assert inj2.fired["heartbeat_delay"] == 1
+
+
+def test_configure_reseeds_and_snapshot(monkeypatch):
+    _armed(monkeypatch)
+    inj = ChaosInjector(seed=5, config={"drop_segment": 0.5})
+    first = [inj.should("drop_segment") for _ in range(20)]
+    snap = inj.configure(seed=5)  # re-seed -> replay the exact schedule
+    assert snap["draws"] == 0
+    assert [inj.should("drop_segment") for _ in range(20)] == first
+
+    snap = inj.configure(armed=False, sever_stream=0.9)
+    assert snap["armed"] is False
+    assert snap["config"]["sever_stream"] == 0.9
+    assert inj.should("sever_stream") is False  # disarmed at runtime
+
+
+def test_drop_object_against_store(monkeypatch, ray_session):
+    """drop_object deletes the shm bytes but leaves the meta — the exact
+    lost-segment shape lineage reconstruction recovers from."""
+    ray = ray_session
+    from ray_tpu._private import state
+
+    ctrl = state.global_client().controller
+
+    @ray.remote
+    def make():
+        return np.arange(50_000, dtype=np.float64)  # shm-sized
+
+    ref = make.remote()
+    ray.get(ref, timeout=60)  # sealed into shm, registered head-side
+    meta = ctrl.objects[ref.id]
+    assert meta.location == "shm"
+    assert ChaosInjector.drop_object(ctrl, ref.id) is True
+    assert not ctrl.store.exists(ref.id)
+    assert ctrl.objects[ref.id].location == "shm"  # meta survives
+    # a second drop is a no-op, not an error
+    assert ChaosInjector.drop_object(ctrl, ref.id) is False
+    # and get() still returns the bytes via the recovery path
+    out = ray.get(ref, timeout=60)
+    assert out.shape == (50_000,) and float(out[123]) == 123.0
+
+
+def test_retry_backoff_deterministic_and_bounded(monkeypatch):
+    from ray_tpu._private.node_agent import retry_backoff_s, transfer_deadline_s
+
+    seq = [retry_backoff_s(i, key="obj-x") for i in range(6)]
+    assert seq == [retry_backoff_s(i, key="obj-x") for i in range(6)]
+    assert all(0.0 <= d <= 2.0 for d in seq)
+    # exponential shape: later attempts back off more until the cap
+    assert seq[4] > seq[1]
+    # different keys de-synchronize (jitter), same base schedule bounds
+    assert [retry_backoff_s(i, key="obj-y") for i in range(6)] != seq
+
+    monkeypatch.setenv("RAY_TPU_TRANSFER_DEADLINE_S", "12.5")
+    assert transfer_deadline_s() == 12.5
+    monkeypatch.setenv("RAY_TPU_TRANSFER_DEADLINE_S", "0.01")
+    assert transfer_deadline_s() == 1.0  # floor
+    monkeypatch.delenv("RAY_TPU_TRANSFER_DEADLINE_S")
+    assert transfer_deadline_s() == 30.0
+
+
+def test_reconstruct_enabled_knob(monkeypatch):
+    from ray_tpu._private.controller import reconstruct_enabled
+
+    monkeypatch.delenv("RAY_TPU_RECONSTRUCT", raising=False)
+    assert reconstruct_enabled() is True
+    monkeypatch.setenv("RAY_TPU_RECONSTRUCT", "0")
+    assert reconstruct_enabled() is False

@@ -133,3 +133,35 @@ print("HATCH-OK")
         env={**os.environ, "JAX_PLATFORMS": "cpu"})
     assert out.returncode == 0, out.stderr[-2000:]
     assert "HATCH-OK" in out.stdout
+
+
+# ------------------------------------------------- GC-reentrant owned table
+
+def test_owned_table_survives_gc_reentrant_decref():
+    """The owned table's lock is taken by ObjectRef.__del__ → decref. GC can
+    fire inside any allocation made while the lock is already held on the
+    SAME thread (waiter() creating its Event, refcount bumps), so the lock
+    must be reentrant — a plain Lock deadlocks the whole client there."""
+    from ray_tpu._private.client import _OwnedTable
+
+    t = _OwnedTable()
+    t.add_pending(["oid-a", "oid-b"])
+
+    # non-blocking double-acquire: RLock says True, a plain Lock says False
+    # (and the real failure mode is an untestable infinite hang)
+    assert t._lock.acquire(blocking=False)
+    try:
+        nested = t._lock.acquire(blocking=False)
+        assert nested, "owned-table lock must be reentrant (GC-time decref)"
+        t._lock.release()
+        # what a mid-waiter GC actually does: drop an unrelated ref while
+        # the outer frame still holds the lock
+        t.decref("oid-b")
+    finally:
+        t._lock.release()
+
+    assert t.peek("oid-b") is None and t.peek("oid-a") is None
+    desc, ev = t.waiter("oid-a")
+    assert desc is None and ev is not None
+    t.resolve([("oid-a", "inline", b"x", 1, 1)])
+    assert ev.is_set() and t.peek("oid-a") == ("inline", b"x")
