@@ -424,6 +424,12 @@ def smoke():
     rec.update(run_all(steps=5, block_mb=8, compute_s=0.05))
     assert rec["hit_rate"] >= 0.9, rec
     assert rec["prefetch"]["wall_s"] <= rec["legacy"]["wall_s"] * 1.25, rec
+    # spill-ladder invariant (ISSUE 19): whatever pressure the run built,
+    # the demotion loop must never have spilled a prefetch-pinned object
+    from ray_tpu.util import metrics
+    sc = metrics.spill_counters()
+    rec["spill"] = sc
+    assert sc["pinned_demotions"] == 0, sc
     print(json.dumps(rec))
 
 

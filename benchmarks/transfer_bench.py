@@ -224,12 +224,61 @@ def _pipeline_section(cl, blocks, rows=40_000):
             + (staged1 - staged0) + (xfer1 - xfer0)}
 
 
+def _tiered_section(size_mb, reps):
+    """Per-tier restore bandwidth (ISSUE 19 spill ladder): MB/s reading a
+    blob resident in the shm tier, restoring it whole from the disk
+    (spilled) tier, and ranged-reading it straight from the spill file —
+    the three sources the pull ladder can land bytes from. Uses a private
+    StoreClient so the measurement never races the live session's table."""
+    from ray_tpu._private.object_store import StoreClient
+    from ray_tpu.util import metrics
+
+    nbytes = size_mb << 20
+    blob = os.urandom(nbytes)
+    store = StoreClient()
+    shm_r, restore_r, ranged_r = [], [], []
+    try:
+        for rep in range(reps):
+            oid = f"tierbench{rep}"
+            store.put_raw(oid, blob)
+            t0 = time.perf_counter()
+            data = bytes(store.read_raw(oid))
+            shm_r.append(size_mb / max(time.perf_counter() - t0, 1e-9))
+            assert len(data) == nbytes
+            del data
+
+            path = store.spill(oid)
+            t0 = time.perf_counter()
+            step = nbytes // 8
+            got = b"".join(store.read_spilled_range(path, i * step, step)
+                           for i in range(8))
+            ranged_r.append(size_mb / max(time.perf_counter() - t0, 1e-9))
+            assert got == blob
+            del got
+
+            t0 = time.perf_counter()
+            store.restore(oid, path)
+            restore_r.append(size_mb / max(time.perf_counter() - t0, 1e-9))
+            assert bytes(store.read_raw(oid)) == blob
+            store.delete_segment(oid)
+    finally:
+        store.close()
+    sc = metrics.spill_counters()
+    return {"size_mb": size_mb,
+            "shm_read_mbps_p50": round(_p50(shm_r), 1),
+            "disk_restore_mbps_p50": round(_p50(restore_r), 1),
+            "disk_ranged_mbps_p50": round(_p50(ranged_r), 1),
+            "spill_bytes": sc["spill_bytes"],
+            "restore_bytes": sc["restore_bytes"]}
+
+
 def run_all(size_mb, reps, small_n, blocks):
     cl = _Cluster()
     try:
         rec = {"transfer": _transfer_section(cl, size_mb, reps),
                "batched_get": _batched_get_section(cl, small_n, reps),
-               "pipeline": _pipeline_section(cl, blocks)}
+               "pipeline": _pipeline_section(cl, blocks),
+               "tiered": _tiered_section(size_mb, reps)}
         from ray_tpu.util import metrics
         rec["counters"] = metrics.transfer_counters()
         return rec
@@ -260,6 +309,10 @@ def smoke():
     assert pipe["locality_hit_rate"] >= 0.9, pipe
     assert pipe["cross_node_block_bytes"] < (1 << 20), pipe
     assert rec["batched_get"]["batched_s_p50"] > 0
+    tier = rec["tiered"]
+    assert tier["disk_restore_mbps_p50"] > 0, tier
+    assert tier["disk_ranged_mbps_p50"] > 0, tier
+    assert tier["restore_bytes"] >= tier["size_mb"] << 20, tier
     print(json.dumps(rec))
 
 

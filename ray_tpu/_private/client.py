@@ -464,8 +464,34 @@ class BaseClient:
             if kind == "inline":
                 out.append(serialization.unpack(payload))
             else:  # shm
-                out.append(self.store.get(oid, payload))
+                try:
+                    out.append(self.store.get(oid, payload))
+                except FileNotFoundError:
+                    out.append(self._reread_demoted(oid))
         return out
+
+    def _reread_demoted(self, oid, attempts=16):
+        """The shm read raced the spill ladder: the segment was demoted back
+        to disk between the descriptor reply and our copy-out (a batched get
+        of a working set larger than the arena cannot keep every object
+        resident at once). Re-request this ONE descriptor — the owner
+        restores the segment — and read immediately; the single-object
+        window is tiny, so this converges even under heavy churn."""
+        for _ in range(attempts):
+            kind, payload = self._descriptor_for(oid)
+            if kind == "err":
+                raise payload
+            if kind == "inline":
+                return serialization.unpack(payload)
+            try:
+                return self.store.get(oid, payload)
+            except FileNotFoundError:
+                continue
+        raise FileNotFoundError(
+            f"object {oid} kept being demoted between restore and read")
+
+    def _descriptor_for(self, oid):
+        raise NotImplementedError
 
     def _encode_to_store(self, oid, value):
         """Serialize once; returns (meta_len, size, inline_or_None, contained
@@ -477,8 +503,24 @@ class BaseClient:
         size = serialization.total_size(meta, buffers)
         if size <= _INLINE_MAX:
             return 0, size, serialization.pack_parts(meta, buffers), contained
-        self.store.put_parts(oid, meta, buffers)
+        try:
+            self.store.put_parts(oid, meta, buffers)
+        except MemoryError:
+            # arena full (or too fragmented to fit `size` contiguously):
+            # ask the owner to demote cold objects to disk and retry —
+            # first down to the pressure target, then draining everything
+            # unpinned before letting the put fail
+            self._request_spill(size, hard=False)
+            try:
+                self.store.put_parts(oid, meta, buffers)
+            except MemoryError:
+                self._request_spill(size, hard=True)
+                self.store.put_parts(oid, meta, buffers)
         return len(meta), size, None, contained
+
+    def _request_spill(self, size, hard):
+        """Ask the controller to make room in the shm tier (overridden per
+        transport); the base client has no control plane to ask."""
 
     def put_serialized(self, meta, buffers, contained):
         """put() for an ALREADY-serialized value (encode_arg's implicit put
@@ -657,6 +699,12 @@ class DriverClient(BaseClient):
 
     def register_actor(self, spec, options):
         return self._call_soon(self.controller.register_actor, spec, options)
+
+    def _request_spill(self, size, hard):
+        self._call_soon(self.controller.spill_for_put, size, hard)
+
+    def _descriptor_for(self, oid):
+        return self._call(self.controller.get_descriptors([oid], None))[0]
 
     # deltas ride the flusher (the sink swallows loop-closed RuntimeError at
     # shutdown, like the old direct call_soon_threadsafe wrappers did); the
@@ -1062,6 +1110,12 @@ class WorkerClient(BaseClient):
     def register_actor(self, spec, options):
         # worker-side actor creation goes through submit path with options piggybacked
         return self._rpc("register_actor_rpc", spec=spec, options=options)["actor_id"]
+
+    def _request_spill(self, size, hard):
+        self._rpc("spill", timeout=60, bytes=size, hard=hard)
+
+    def _descriptor_for(self, oid):
+        return self._rpc("get", oids=[oid], timeout=None)["results"][0]
 
     # deltas ride the flusher (append cannot fail; the sink swallows OSError
     # at shutdown, like the old per-message try/except did); the owned table

@@ -50,6 +50,29 @@ _SCHED_BATCH_MAX = 1024
 DEFAULT_CAPACITY = int(os.environ.get("RAY_TPU_STORE_BYTES", 8 << 30))
 
 
+# -- spill-ladder policy knobs (ISSUE 19) ------------------------------------
+# The synchronous over-capacity path (register_put → _maybe_spill) is the
+# backstop; the background demotion loop (reaper → _spill_tick) drains AHEAD
+# of it, driven by the same store-pressure gauge the health plane exports.
+
+def spill_threshold() -> float:
+    """Store-used fraction above which the background loop starts demoting
+    (RAY_TPU_SPILL_THRESHOLD, default 0.9)."""
+    return float(os.environ.get("RAY_TPU_SPILL_THRESHOLD", 0.9))
+
+
+def spill_target() -> float:
+    """Fraction the background loop drains down to (RAY_TPU_SPILL_TARGET,
+    default 0.7 — below the threshold so the loop doesn't chatter)."""
+    return float(os.environ.get("RAY_TPU_SPILL_TARGET", 0.7))
+
+
+def spill_interval_s() -> float:
+    """Minimum seconds between background demotion scans
+    (RAY_TPU_SPILL_INTERVAL)."""
+    return float(os.environ.get("RAY_TPU_SPILL_INTERVAL", 1.0))
+
+
 def format_timeline(entries) -> List[dict]:
     """Expand the timeline ring into Chrome trace_event dicts. The
     completion hot path appends raw tuples (one per task); the dict +
@@ -526,6 +549,8 @@ class Controller:
         self.pending_reqs: Dict[str, asyncio.Future] = {}
         self.store_used = 0
         self.store_capacity = store_capacity
+        self.store_spilled_bytes = 0   # disk-tier occupancy (spill ladder)
+        self._last_spill_scan = 0.0
         self.tpu_free: List[int] = list(range(int(resources.get("TPU", 0))))
         self._server = None
         self._shutdown = False
@@ -619,6 +644,7 @@ class Controller:
                 object_id=oid, size=rec["size"], meta_len=rec["meta_len"],
                 location="spilled", spill_path=rec["path"],
                 refcount=1)  # session-held ref: survives driver turnover
+            self.store_spilled_bytes += rec["size"]
             ev = asyncio.Event()
             ev.set()
             self.object_events[oid] = ev
@@ -711,6 +737,10 @@ class Controller:
                     self.reconciler.tick()
                 except Exception:  # noqa: BLE001 - ditto for the reconciler
                     pass
+            try:
+                self._spill_tick()
+            except Exception:  # noqa: BLE001 - spill policy must not kill it
+                pass
             self._schedule()
 
     # ------------------------------------------------------- worker connection
@@ -798,6 +828,9 @@ class Controller:
             self._reply(w, p["req_id"],
                         locations=[self._object_location(o)
                                    for o in p["oids"]])
+        elif kind == "spill":
+            self.spill_for_put(p["bytes"], hard=p.get("hard", False))
+            self._reply(w, p["req_id"], ok=True)
         elif kind == "hello":
             # attach handshake: the session's shm arena + job identity so a
             # process with no inherited env can join (ref: ray.init(address=))
@@ -1832,6 +1865,7 @@ class Controller:
             "store_used": self.store_used,
             "store_capacity": self.store_capacity,
             "store_free": max(self.store_capacity - self.store_used, 0),
+            "store_spilled_bytes": self.store_spilled_bytes,
             "store_pinned_bytes": sum(m.size for m in self.objects.values()
                                       if m.pinned > 0 and m.location == "shm"),
             "store_objects": len(self.objects),
@@ -2505,7 +2539,12 @@ class Controller:
         if self.prefetch is None or not prefetch_enabled():
             return
         meta = self.objects.get(oid)
-        if meta is None or not meta.location.startswith("remote:"):
+        if meta is None:
+            return
+        if meta.location == "spilled":
+            self._restore_request(oid, meta)
+            return
+        if not meta.location.startswith("remote:"):
             return
 
         async def fetch():
@@ -2518,6 +2557,60 @@ class Controller:
                     m.prefetched = True
                 if not ok:
                     self._resolve_dep(oid)
+            return ok
+
+        self.prefetch.request(oid, meta.size, fetch)
+
+    def _restore_request(self, oid: str, meta):
+        """Restore-before-dispatch: a spilled task arg is promoted back to
+        shm through the same PullManager as remote pulls — single-flight,
+        byte-capped, and pin/unpin-bracketed so the landing object can't be
+        re-demoted mid-restore. File I/O runs in the executor; the loop
+        thread re-checks location before mutating meta (idempotent against
+        a concurrent inline _ensure_local, whose store.restore early-returns
+        once the segment exists). Unlike remote pulls there is no ingest
+        path to resolve gated waiters, so both outcomes resolve here; a
+        failed restore degrades to the dispatch-time _ensure_local fallback
+        in _arg_descriptors (a miss, not an error)."""
+
+        async def fetch():
+            ok = False
+            try:
+                m = self.objects.get(oid)
+                if m is None:
+                    return False
+                if m.location != "spilled":
+                    return m.location in ("shm", "inline")
+                path = m.spill_path
+                self._make_room_for_restore(m.size)
+                try:
+                    size = await self.loop.run_in_executor(
+                        None, self.store.restore, oid, path)
+                except MemoryError:  # fragmentation: demote harder, retry
+                    self._spill_down(0, pressure=True)
+                    size = await self.loop.run_in_executor(
+                        None, self.store.restore, oid, path)
+                m2 = self.objects.get(oid)
+                if m2 is not None and m2.location == "spilled":
+                    m2.location = "shm"
+                    m2.spill_path = None
+                    self.store_used += size
+                    self.store_spilled_bytes = max(
+                        self.store_spilled_bytes - size, 0)
+                    if self.gcs is not None:
+                        self.gcs.record("object_gone", object_id=oid)
+                from ..util import metrics
+                metrics.get_or_create(
+                    metrics.Counter, "restored_objects_total",
+                    "objects promoted disk → shm").inc()
+                ok = True
+            except Exception:  # noqa: BLE001 - degrade to exec-time restore
+                ok = False
+            finally:
+                m = self.objects.get(oid)
+                if ok and m is not None:
+                    m.prefetched = True
+                self._resolve_dep(oid)
             return ok
 
         self.prefetch.request(oid, meta.size, fetch)
@@ -2536,7 +2629,8 @@ class Controller:
             if kind != "ref" or v in rec.prefetch_tried:
                 continue
             meta = self.objects.get(v)
-            if meta is None or not meta.location.startswith("remote:"):
+            if meta is None or not (meta.location.startswith("remote:")
+                                    or meta.location == "spilled"):
                 continue
             rec.prefetch_tried.add(v)
             rec.deps_remaining.add(v)
@@ -2631,36 +2725,156 @@ class Controller:
                 out |= pm.protected()
         return out
 
+    def spill_for_put(self, size: int, hard: bool = False):
+        """Synchronous make-room call for a client whose arena allocation
+        failed: clients write puts straight into shm, so the background
+        pressure loop can be behind (or the slab fragmented below the
+        accounting watermark) when they hit the wall. hard drains every
+        unpinned shm object — the last resort before the put errors out."""
+        if hard:
+            self._spill_down(0, pressure=True)
+        else:
+            self._spill_down(
+                max(0.0, min(self.store_capacity * spill_target(),
+                             self.store_capacity - size)), pressure=True)
+
     def _maybe_spill(self):
         """Spill oldest unpinned shm objects when over capacity (ref: plasma
-        eviction + object spilling, src/ray/object_manager/spilled_object)."""
+        eviction + object spilling, src/ray/object_manager/spilled_object).
+        The synchronous backstop of the ladder — the background _spill_tick
+        usually drains before this fires."""
         if self.store_used <= self.store_capacity:
             return
+        self._spill_down(self.store_capacity * 0.8)
+
+    def _spill_tick(self):
+        """Background demotion loop (ISSUE 19): runs off the reaper at
+        spill_interval_s cadence, watching the same store-pressure gauge
+        the health plane exports. Past RAY_TPU_SPILL_THRESHOLD it demotes
+        shm → disk down to RAY_TPU_SPILL_TARGET, so the synchronous
+        over-capacity path on the put hot path rarely has work left."""
+        now = time.monotonic()
+        if now - self._last_spill_scan < spill_interval_s():
+            return
+        self._last_spill_scan = now
+        if self.store_used > self.store_capacity * spill_threshold():
+            self._spill_down(self.store_capacity * spill_target(),
+                             pressure=True)
+        self._tier_gauges()
+
+    def _spill_down(self, target_bytes: float, pressure: bool = False):
+        """Demote oldest unpinned shm objects until store_used ≤ target.
+        Prefetch pinning is honored twice: the snapshot skip (counted on
+        spill_pinned_skips_total) and a fresh re-check right before each
+        spill — a protected object demoted anyway would land on
+        spill_pinned_demotions_total, the invariant counter the chain-bench
+        smoke asserts stays zero."""
+        from ..util import metrics
         protected = self._spill_protected()
+        skips = spilled = 0
         for oid, meta in list(self.objects.items()):
-            if self.store_used <= self.store_capacity * 0.8:
+            if self.store_used <= target_bytes:
                 break
-            if oid in protected or meta.prefetched:
+            if meta.location != "shm" or meta.pinned != 0:
                 continue
-            if meta.location == "shm" and meta.pinned == 0:
-                try:
-                    meta.spill_path = self.store.spill(oid)
-                    meta.location = "spilled"
-                    self.store_used -= meta.size
-                    if self.gcs is not None:
-                        self.gcs.record("spilled", object_id=oid,
-                                        path=meta.spill_path, size=meta.size,
-                                        meta_len=meta.meta_len)
-                except Exception:  # noqa: BLE001 - best-effort under pressure
-                    continue
+            if oid in protected or meta.prefetched:
+                skips += 1
+                continue
+            m2 = self.objects.get(oid)
+            if (m2 is not meta or meta.pinned != 0 or meta.prefetched
+                    or oid in self._spill_protected()):
+                metrics.get_or_create(
+                    metrics.Counter, "spill_pinned_demotions_total",
+                    "protected objects demoted anyway (must stay 0)").inc()
+                continue
+            try:
+                meta.spill_path = self.store.spill(oid)
+                meta.location = "spilled"
+                self.store_used -= meta.size
+                self.store_spilled_bytes += meta.size
+                spilled += 1
+                if self.gcs is not None:
+                    self.gcs.record("spilled", object_id=oid,
+                                    path=meta.spill_path, size=meta.size,
+                                    meta_len=meta.meta_len)
+            except Exception:  # noqa: BLE001 - best-effort under pressure
+                continue
+        if spilled:
+            metrics.get_or_create(
+                metrics.Counter, "spilled_objects_total",
+                "objects demoted shm → disk").inc(spilled)
+            if pressure:
+                metrics.get_or_create(
+                    metrics.Counter, "spill_pressure_total",
+                    "objects demoted by the background pressure loop"
+                ).inc(spilled)
+        if skips:
+            metrics.get_or_create(
+                metrics.Counter, "spill_pinned_skips_total",
+                "demotion candidates spared by prefetch/pull pinning"
+            ).inc(skips)
+
+    def _tier_gauges(self):
+        """Export per-tier occupancy (owner=store series; the serve-side KV
+        stash publishes owner=kv_stash on the same families)."""
+        try:
+            from ..util import metrics
+            tags = {"owner": "store"}
+            shm_objects = disk_objects = 0
+            for m in self.objects.values():
+                if m.location == "shm":
+                    shm_objects += 1
+                elif m.location == "spilled":
+                    disk_objects += 1
+
+            def g(name, desc):
+                return metrics.get_or_create(metrics.Gauge, name, desc,
+                                             tag_keys=("owner",))
+            g("store_tier_shm_bytes",
+              "bytes resident in the shm tier").set(self.store_used, tags)
+            g("store_tier_disk_bytes",
+              "bytes demoted to the disk tier").set(
+                  self.store_spilled_bytes, tags)
+            g("store_tier_shm_objects",
+              "objects resident in the shm tier").set(shm_objects, tags)
+            g("store_tier_disk_objects",
+              "objects demoted to the disk tier").set(disk_objects, tags)
+        except Exception:  # noqa: BLE001 - gauges must not break the reaper
+            pass
+
+    def _make_room_for_restore(self, size: int):
+        """Demote cold shm objects so a promotion from disk fits. Working
+        sets ≫ RAM churn both directions through the ladder — a full arena
+        must never fail a get() on a spilled object."""
+        if self.store_used + size > self.store_capacity:
+            self._spill_down(
+                max(0.0, min(self.store_capacity * spill_target(),
+                             self.store_capacity - size)), pressure=True)
+
+    def _restore_segment(self, oid: str, spill_path):
+        """store.restore with the make-room dance: slab fragmentation can
+        exhaust the arena below the accounting watermark, so a MemoryError
+        here means "demote harder and retry once", not "fail the get"."""
+        self._make_room_for_restore(self.objects[oid].size)
+        try:
+            return self.store.restore(oid, spill_path)
+        except MemoryError:
+            self._spill_down(0, pressure=True)
+            return self.store.restore(oid, spill_path)
 
     def _ensure_local(self, oid: str):
         meta = self.objects[oid]
         if meta.location == "spilled":
-            self.store.restore(oid, meta.spill_path)
+            self._restore_segment(oid, meta.spill_path)
             meta.location = "shm"
             meta.spill_path = None
             self.store_used += meta.size
+            self.store_spilled_bytes = max(
+                self.store_spilled_bytes - meta.size, 0)
+            from ..util import metrics
+            metrics.get_or_create(
+                metrics.Counter, "restored_objects_total",
+                "objects promoted disk → shm").inc()
             if self.gcs is not None:  # restore deletes the spill file
                 self.gcs.record("object_gone", object_id=oid)
 
@@ -2908,6 +3122,8 @@ class Controller:
                 os.remove(meta.spill_path)
             except OSError:
                 pass
+            self.store_spilled_bytes = max(
+                self.store_spilled_bytes - meta.size, 0)
             if self.gcs is not None:
                 self.gcs.record("object_gone", object_id=oid)
         self.object_events.pop(oid, None)

@@ -462,8 +462,14 @@ class ObjectDataServer:
             await writer.drain()
             return
         try:
-            c._ensure_local(oid)
-            blob = c.store.read_raw(oid)
+            if meta.location == "spilled" and meta.spill_path:
+                # ship from the spill tier without promoting: the reader
+                # wants the bytes, not a hot shm copy on this node
+                blob = await asyncio.get_running_loop().run_in_executor(
+                    None, c.store.read_spilled, meta.spill_path)
+            else:
+                c._ensure_local(oid)
+                blob = c.store.read_raw(oid)
         except Exception:  # noqa: BLE001 - segment vanished under us
             writer.write(b"MISS\n")
             await writer.drain()
@@ -492,8 +498,20 @@ class ObjectDataServer:
             await writer.drain()
             return
         try:
-            self.c._ensure_local(oid)
-            blob = self.c.store.read_range(oid, offset, length)
+            if meta.location == "spilled" and meta.spill_path:
+                # serve straight from the spill file: a ranged pull of a
+                # cold object must not promote it back to shm (and evict
+                # something hot) just to ship a slice
+                blob = await asyncio.get_running_loop().run_in_executor(
+                    None, self.c.store.read_spilled_range,
+                    meta.spill_path, offset, length)
+                from ..util import metrics
+                metrics.get_or_create(
+                    metrics.Counter, "spill_range_reads_total",
+                    "ranged reads served directly from the spill tier").inc()
+            else:
+                self.c._ensure_local(oid)
+                blob = self.c.store.read_range(oid, offset, length)
         except Exception:  # noqa: BLE001 - segment vanished under us
             writer.write(b"MISS\n")
             await writer.drain()

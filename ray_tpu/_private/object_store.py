@@ -16,6 +16,7 @@ high-churn small objects.
 
 import hashlib
 import os
+import time
 import weakref
 from multiprocessing import shared_memory, resource_tracker
 
@@ -24,6 +25,23 @@ from . import serialization
 def _spill_dir() -> str:
     from . import paths
     return paths.subdir("spill")
+
+
+def _note_spill_io(kind: str, nbytes: int, ms: float):
+    """Tally spill-ladder traffic for the health plane (`kind` is "spill" or
+    "restore"). Accounting must never mask the I/O outcome."""
+    try:
+        from ray_tpu.util import metrics
+        metrics.get_or_create(
+            metrics.Counter, f"{kind}_bytes_total",
+            f"bytes moved across the {kind} tier boundary").inc(nbytes)
+        metrics.get_or_create(
+            metrics.Histogram, "spill_restore_ms",
+            "spill/restore I/O latency (ms)",
+            boundaries=(1, 5, 10, 50, 100, 500, 1000, 5000),
+        ).observe(ms, tags={"op": kind})
+    except Exception:  # noqa: BLE001
+        pass
 
 # The stdlib resource_tracker assumes whoever creates a segment owns cleanup;
 # our segments outlive their creator (controller manages lifetime), which
@@ -374,19 +392,76 @@ class StoreClient:
 
     # -- spilling ------------------------------------------------------------
     def spill(self, object_id: str) -> str:
-        """Copy object to disk and free it. Returns the spill path."""
+        """Demote the object to the disk tier and free its shm. Returns the
+        spill path.
+
+        Atomicity: the bytes land in a `.tmp` sidecar first and only an
+        os.replace publishes them under the final name — a node killed
+        mid-spill leaves at worst an ignorable sidecar, never a truncated
+        file at a path a later restore would trust. The shm segment is
+        freed only AFTER the rename, so a crash anywhere in between keeps
+        the shm copy authoritative."""
+        t0 = time.monotonic()
         path = os.path.join(_spill_dir(), seg_name(object_id))
         data = self.read_raw(object_id)
-        with open(path, "wb") as f:
-            f.write(data)
+        tmp = path + f".tmp.{os.getpid()}"
+        try:
+            with open(tmp, "wb") as f:
+                f.write(data)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
         self.delete_segment(object_id)
+        _note_spill_io("spill", len(data), (time.monotonic() - t0) * 1e3)
         return path
 
     def restore(self, object_id: str, path: str) -> int:
+        """Promote a spilled object back into shm and retire the spill file.
+
+        Concurrent-restore safety: the spill path is derived from
+        seg_name(object_id) — the same name a live segment of this object
+        would use — so a restore racing a second restore (or a stale
+        registry entry) must not clobber live bytes. If the segment already
+        exists the object is already resident: return its size and leave
+        the spill file for the loser's os.remove (idempotent)."""
+        t0 = time.monotonic()
+        if self.exists(object_id):
+            size = len(self.read_raw(object_id))
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+            return size
         with open(path, "rb") as f:
             blob = f.read()
-        os.remove(path)
-        return self.put_raw(object_id, blob)
+        size = self.put_raw(object_id, blob)
+        try:
+            os.remove(path)
+        except OSError:
+            pass  # concurrent restore already retired it
+        _note_spill_io("restore", size, (time.monotonic() - t0) * 1e3)
+        return size
+
+    @staticmethod
+    def read_spilled_range(path: str, offset: int, length: int) -> bytes:
+        """Serve one slice straight from a spill file — the data server's
+        ranged GET for the disk tier (no full restore, no shm allocation;
+        the spill write is atomic so any file at `path` is complete)."""
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read(length)
+
+    @staticmethod
+    def read_spilled(path: str) -> bytes:
+        """Whole-blob read from the disk tier without promoting to shm."""
+        with open(path, "rb") as f:
+            return f.read()
 
     def release_pins_of(self, pid: int) -> int:
         """Reclaim every arena pin held by a (dead) client process — the

@@ -50,6 +50,19 @@ def kv_ship_enabled() -> bool:
     return os.environ.get("RAY_TPU_KV_SHIP", "1") != "0"
 
 
+def kv_demote_enabled() -> bool:
+    """Radix-evicted KV pages demote into object-store segments instead of
+    being discarded (ISSUE 19 tiering); RAY_TPU_SPILL_KV=0 restores
+    discard-on-evict."""
+    return os.environ.get("RAY_TPU_SPILL_KV", "1") != "0"
+
+
+def stash_budget_bytes() -> int:
+    """shm budget for demoted KV pages before the stash spills its oldest
+    segments to the disk tier (RAY_TPU_SPILL_STASH_BYTES)."""
+    return int(os.environ.get("RAY_TPU_SPILL_STASH_BYTES", 256 << 20))
+
+
 def local_attach_enabled() -> bool:
     """RAY_TPU_KV_ATTACH=0 disables the same-host zero-copy attach so
     tests can force the parallel_fetch / RPC pull paths on one host."""
@@ -159,6 +172,141 @@ class ShipWriter:
     def close(self) -> None:
         for ship_id in list(self._ship_oids):
             self.drop_ship(ship_id)
+
+
+class KVPageStash:
+    """Demotion tier for radix prefix pages (ISSUE 19 tiering, the HBM
+    edge of the spill ladder).
+
+    When the radix tree LRU-evicts a cold prefix page, its KV is sealed
+    into a pershm store segment here (same Create→fill→Seal plane the PD
+    shipment uses) instead of being discarded; a later request matching
+    the node restores the bytes into a fresh HBM page rather than
+    recomputing prefill. Restore walks the same rung order as ShipReader's
+    pull ladder: same-host shm attach first, then the DISK tier — under
+    shm pressure (`stash_budget_bytes`) the stash demotes its oldest
+    segments with ``StoreClient.spill`` (atomic temp+rename files), and a
+    hit on a disk-resident handle promotes it back through
+    ``StoreClient.restore``. Per-tier occupancy is exported on the
+    ``store_tier_*`` gauges under the ``owner=kv_stash`` series.
+
+    Handles are content-immutable (a prefix page's tokens fully determine
+    its KV), so a handle stays valid across any number of demote/restore
+    round trips."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        import collections
+        self.store = StoreClient(backend="pershm")
+        self._seq = itertools.count(1)
+        self._shm: "collections.OrderedDict[str, int]" = \
+            collections.OrderedDict()          # oid -> nbytes, oldest first
+        self._disk: Dict[str, Tuple[str, int]] = {}   # oid -> (path, nbytes)
+        self.budget = (stash_budget_bytes() if budget_bytes is None
+                       else budget_bytes)
+        self.shm_bytes = 0
+        self.disk_bytes = 0
+
+    def _gauge(self):
+        try:
+            tags = {"owner": "kv_stash"}
+            g = lambda name, desc: _metrics.get_or_create(  # noqa: E731
+                _metrics.Gauge, name, desc, tag_keys=("owner",))
+            g("store_tier_shm_bytes",
+              "bytes resident in the shm tier").set(self.shm_bytes, tags)
+            g("store_tier_disk_bytes",
+              "bytes demoted to the disk tier").set(self.disk_bytes, tags)
+            g("store_tier_shm_objects",
+              "objects resident in the shm tier").set(len(self._shm), tags)
+            g("store_tier_disk_objects",
+              "objects demoted to the disk tier").set(len(self._disk), tags)
+        except Exception:  # noqa: BLE001 - accounting never breaks serving
+            pass
+
+    def put(self, k_page: np.ndarray, v_page: np.ndarray) -> Dict[str, Any]:
+        """Seal one evicted page's KV (k block then v block, C-contiguous)
+        and return its restore handle."""
+        k_page = np.ascontiguousarray(k_page)
+        v_page = np.ascontiguousarray(v_page)
+        nbytes = k_page.nbytes + v_page.nbytes
+        oid = f"kvd{_proc_tag}{next(self._seq):08x}"
+        handle = self.store.create_writable(oid, nbytes)
+        try:
+            handle.view[:k_page.nbytes] = _as_bytes(k_page)
+            handle.view[k_page.nbytes:nbytes] = _as_bytes(v_page)
+        except BaseException:
+            handle.abort()
+            raise
+        handle.seal()
+        self._shm[oid] = nbytes
+        self.shm_bytes += nbytes
+        self._enforce_budget()
+        self._gauge()
+        return {"oid": oid, "nbytes": nbytes,
+                "shape": list(k_page.shape), "dtype": k_page.dtype.name}
+
+    def _enforce_budget(self):
+        """shm → disk rung: spill oldest stash segments past the budget."""
+        while self.shm_bytes > self.budget and self._shm:
+            oid, nbytes = self._shm.popitem(last=False)
+            self.shm_bytes -= nbytes
+            try:
+                path = self.store.spill(oid)
+            except Exception:  # noqa: BLE001 - segment vanished → forget it
+                continue
+            self._disk[oid] = (path, nbytes)
+            self.disk_bytes += nbytes
+
+    def get(self, handle: Dict[str, Any]) -> Tuple[np.ndarray, np.ndarray]:
+        """Restore one page's (k, v), promoting a disk-resident segment
+        back to shm first. Byte-exact: the arrays round-trip untouched."""
+        oid = handle["oid"]
+        dtype = _np_dtype(handle["dtype"])
+        if oid in self._disk:
+            path, nbytes = self._disk.pop(oid)
+            self.disk_bytes -= nbytes
+            self.store.restore(oid, path)
+            self._shm[oid] = nbytes
+            self.shm_bytes += nbytes
+            self._enforce_budget()
+        elif oid in self._shm:
+            self._shm.move_to_end(oid)  # hot again
+        blob = self.store.read_raw(oid)
+        self._gauge()
+        shape = tuple(handle["shape"])
+        half = handle["nbytes"] // 2
+        k = np.frombuffer(blob, dtype=dtype, count=half // dtype.itemsize)
+        v = np.frombuffer(blob, dtype=dtype, count=half // dtype.itemsize,
+                          offset=half)
+        return k.reshape(shape), v.reshape(shape)
+
+    def drop(self, handle: Dict[str, Any]) -> None:
+        """The handle will never be restored; free its tier residency."""
+        oid = handle["oid"]
+        if oid in self._shm:
+            self.shm_bytes -= self._shm.pop(oid)
+            try:
+                self.store.delete_segment(oid)
+            except Exception:  # noqa: BLE001
+                pass
+        elif oid in self._disk:
+            path, nbytes = self._disk.pop(oid)
+            self.disk_bytes -= nbytes
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        self._gauge()
+
+    def tier_stats(self) -> Dict[str, int]:
+        return {"shm_objects": len(self._shm), "shm_bytes": self.shm_bytes,
+                "disk_objects": len(self._disk),
+                "disk_bytes": self.disk_bytes}
+
+    def close(self) -> None:
+        for oid in list(self._shm):
+            self.drop({"oid": oid})
+        for oid in list(self._disk):
+            self.drop({"oid": oid})
 
 
 class KVDataServer:

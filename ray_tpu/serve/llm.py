@@ -221,17 +221,35 @@ class LLMServer:
                 "is single-position; the dense cache path verifies [B, K+1] "
                 "windows natively (set paged=False or speculate=0)")
         if cfg.paged:
-            from ray_tpu.ops.paged_attention import PagedKVCache, PageManager
+            from ray_tpu.ops.paged_attention import PagedKVCache
+            from ray_tpu.serve import radix_cache as _radix
             mc = self.model_cfg
             max_pages = -(-cfg.max_seq_len // cfg.page_size)
             num_pages = cfg.num_pages or (B * max_pages + 1)
-            self.page_mgr = PageManager(num_pages, cfg.page_size, B, max_pages,
-                                        prefix_cache=cfg.prefix_cache)
+            # tiered KV (ISSUE 19): the radix tree demotes LRU-evicted
+            # prefix pages into the stash (shm → disk ladder) and restores
+            # them on a later match instead of recomputing prefill
+            self._kv_stash = None
+            self._pending_restores = []
+            hooks = {}
+            if cfg.prefix_cache and _radix.radix_enabled():
+                from ray_tpu.serve.kv_transfer import (KVPageStash,
+                                                       kv_demote_enabled)
+                if kv_demote_enabled():
+                    self._kv_stash = KVPageStash()
+                    hooks = dict(demote_cb=self._demote_page,
+                                 restore_cb=self._restore_page,
+                                 drop_cb=self._drop_page)
+            self.page_mgr = _radix.make_page_manager(
+                num_pages, cfg.page_size, B, max_pages,
+                prefix_cache=cfg.prefix_cache, **hooks)
             self.cache = PagedKVCache.init(
                 mc.n_layers, mc.n_kv_heads, mc.head_dim, num_pages,
                 cfg.page_size, B, max_pages, dtype=mc.dtype)
         else:
             self.page_mgr = None
+            self._kv_stash = None
+            self._pending_restores = []
             if self.mesh is not None:
                 # born sharded on the kv-head axis ([B, Smax, Kh, D]) to
                 # match the tp-sharded wk/wv projections — KV for a head
@@ -696,6 +714,7 @@ class LLMServer:
                 if use_prefix and self.config.prefix_cache:
                     row, cached = mgr.allocate_prefix(
                         slot_idx, list(prompt_ids), total_len)
+                    self._flush_restored_pages()
                 else:
                     row = mgr.allocate(slot_idx, total_len)
                 # lengths[slot] must point PAST the shared prefix before the
@@ -823,6 +842,47 @@ class LLMServer:
                 self._release_slot(i)
             self._active.clear()
             raise
+
+    # -- tiered KV: radix demote/restore hooks (ISSUE 19) --------------------
+    def _demote_page(self, pid: int, node) -> Optional[Dict[str, Any]]:
+        """radix demote_cb: pull page `pid`'s KV ([L, Kh, ps, D] k and v
+        blocks) off the device and seal it into the stash. Runs
+        synchronously inside eviction — the extraction must complete
+        before the pool page can be reused by another request."""
+        import jax
+        k, v = jax.device_get((self.cache.k_pages[:, :, pid],
+                               self.cache.v_pages[:, :, pid]))
+        return self._kv_stash.put(np.asarray(k), np.asarray(v))
+
+    def _restore_page(self, handle: Dict[str, Any], pid: int) -> bool:
+        """radix restore_cb: fetch the demoted page's KV (bit-exact — the
+        stash round-trips raw bytes) and STAGE it; _flush_restored_pages()
+        lands every staged page in one batched scatter right after the
+        allocation. A per-page .at[].set would rewrite the whole pool
+        buffer per page, making restore cost rival the prefill it avoids."""
+        k, v = self._kv_stash.get(handle)
+        self._pending_restores.append((pid, k, v))
+        return True
+
+    def _flush_restored_pages(self) -> None:
+        """Land all staged restores in one scatter along the page axis.
+        Must run before prefill reads the pool (called from the allocate
+        path); the radix manager already counts these pages as cached."""
+        if not self._pending_restores:
+            return
+        import jax.numpy as jnp
+        staged, self._pending_restores = self._pending_restores, []
+        pids = np.array([p for p, _, _ in staged], dtype=np.int32)
+        ks = jnp.moveaxis(
+            jnp.asarray(np.stack([k for _, k, _ in staged])), 0, 2)
+        vs = jnp.moveaxis(
+            jnp.asarray(np.stack([v for _, _, v in staged])), 0, 2)
+        self.cache = self.cache.replace(
+            k_pages=self.cache.k_pages.at[:, :, pids].set(ks),
+            v_pages=self.cache.v_pages.at[:, :, pids].set(vs))
+
+    def _drop_page(self, handle: Dict[str, Any]) -> None:
+        self._kv_stash.drop(handle)
 
     def _release_slot(self, i: int):
         """Return slot i to the pool; paged mode also frees its pages and
@@ -1127,5 +1187,17 @@ class LLMServer:
             "batch_occupancy": _metrics.histogram_summary(
                 "serve_batch_occupancy"),
             "kv_page_util": _metrics.histogram_summary("serve_kv_page_util"),
+            "spill_restore_ms": _metrics.histogram_summary("spill_restore_ms"),
         }
+        from ray_tpu.serve.radix_cache import RadixPageManager
+        if isinstance(self.page_mgr, RadixPageManager):
+            mgr = self.page_mgr
+            s["radix"] = mgr.node_stats()
+            if self._kv_stash is not None:
+                s["radix"]["stash"] = self._kv_stash.tier_stats()
+            s["slo"]["radix"] = {
+                "prefix_nodes": mgr.prefix_nodes,
+                "prefix_hit_tokens": mgr.prefix_hit_tokens,
+                "prefix_evicted_pages": mgr.evicted_pages,
+            }
         return s

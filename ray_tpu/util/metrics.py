@@ -381,3 +381,59 @@ def control_local_gets_total() -> int:
     that never touched the head (zero round trips, zero frames)."""
     from ray_tpu._private import protocol
     return protocol.local_gets_total()
+
+
+# -- tiered-memory (spill ladder + radix KV) read surface --------------------
+# Raw series are written by _private/object_store.py (spill/restore I/O),
+# _private/controller.py (demotion policy decisions, per-tier occupancy
+# gauges) and serve/radix_cache.py (prefix-tree accounting). These helpers
+# flatten them for benchmarks and the tier-1 pinning assert.
+
+def _gauge_total(name: str) -> float:
+    with _registry_lock:
+        m = _registry.get(name)
+    if not isinstance(m, Gauge):
+        return 0.0
+    return sum(m.snapshot()["values"].values())
+
+
+def spill_counters() -> Dict[str, float]:
+    """Spill-ladder tallies (per process — the controller that owns the
+    store). spill/restore_bytes tally tier-boundary I/O; spilled/restored
+    count objects demoted to disk and promoted back; pressure_spills counts
+    demotions triggered by the background pressure loop (vs the synchronous
+    over-capacity path); pinned_skips counts demotion candidates spared
+    because prefetch/pull pinning protected them; pinned_demotions counts
+    protected objects that were ABOUT to be demoted anyway — the invariant
+    the chain-bench smoke asserts stays zero."""
+    return {"spill_bytes": _counter_total("spill_bytes_total"),
+            "restore_bytes": _counter_total("restore_bytes_total"),
+            "spilled_objects": _counter_total("spilled_objects_total"),
+            "restored_objects": _counter_total("restored_objects_total"),
+            "pressure_spills": _counter_total("spill_pressure_total"),
+            "pinned_skips": _counter_total("spill_pinned_skips_total"),
+            "pinned_demotions": _counter_total("spill_pinned_demotions_total"),
+            "range_reads": _counter_total("spill_range_reads_total")}
+
+
+def tier_occupancy() -> Dict[str, float]:
+    """Per-tier occupancy gauges set by the store owner: bytes resident in
+    the shm tier vs demoted to the disk tier, and object counts for each."""
+    return {"shm_bytes": _gauge_total("store_tier_shm_bytes"),
+            "disk_bytes": _gauge_total("store_tier_disk_bytes"),
+            "shm_objects": _gauge_total("store_tier_shm_objects"),
+            "disk_objects": _gauge_total("store_tier_disk_objects")}
+
+
+def radix_counters() -> Dict[str, float]:
+    """Radix prefix-cache tallies (per serving process). prefix_nodes is
+    the live trie size; hit_tokens/query_tokens give the exact per-node
+    prefix hit rate; evicted_pages counts pages LRU-evicted off the tree;
+    demoted/restored_pages split eviction into discard vs demote-to-store
+    and the pages later pulled back instead of recomputed."""
+    return {"prefix_nodes": _gauge_total("radix_prefix_nodes"),
+            "hit_tokens": _counter_total("radix_hit_tokens"),
+            "query_tokens": _counter_total("radix_query_tokens"),
+            "evicted_pages": _counter_total("radix_evicted_pages"),
+            "demoted_pages": _counter_total("radix_demoted_pages"),
+            "restored_pages": _counter_total("radix_restored_pages")}

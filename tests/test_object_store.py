@@ -1,0 +1,362 @@
+"""Tiered object memory: atomic spill/restore + the spill ladder (ISSUE 19).
+
+  * spill writes temp-then-rename: a kill mid-spill can never leave a
+    truncated file at the trusted path, and a failed rename leaves the shm
+    segment intact (the object is never lost to a half-spill)
+  * restore round-trips bit-identically and is idempotent under concurrent
+    restore: a live segment wins, the loser's file is removed, no collision
+  * read_spilled_range serves slices straight from the spill file
+  * the controller's background pressure loop demotes cold shm objects but
+    never a prefetch-pinned/protected one (spill_pinned_demotions_total == 0)
+  * a spilled task arg is restored to shm BEFORE dispatch via the
+    PullManager, and the task sees correct bytes
+  * a ranged pull of a spilled object is served from the spill file without
+    promoting it back to shm (the spilled tier is a pull source)
+"""
+
+import asyncio
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_script(body, env_extra=None, timeout=240):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", RAY_TPU_NUM_CHIPS="0")
+    env.pop("RAY_TPU_ARENA", None)
+    env.pop("RAY_TPU_ADDRESS", None)
+    env.update(env_extra or {})
+    r = subprocess.run([sys.executable, "-c", body], capture_output=True,
+                       text=True, timeout=timeout, env=env, cwd=REPO)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    return r.stdout
+
+
+def _fresh_store(monkeypatch):
+    monkeypatch.delenv("RAY_TPU_ARENA", raising=False)
+    from ray_tpu._private.object_store import StoreClient
+    return StoreClient()
+
+
+# ------------------------------------------------------------- atomic spill
+
+def test_spill_restore_bit_identical(monkeypatch):
+    from ray_tpu._private.object_store import _spill_dir, seg_name
+
+    store = _fresh_store(monkeypatch)
+    try:
+        blob = os.urandom(1 << 16)
+        store.put_raw("oidA", blob)
+        path = store.spill("oidA")
+        assert os.path.basename(path) == seg_name("oidA")
+        assert not store.exists("oidA")          # shm copy gone
+        with open(path, "rb") as f:
+            assert f.read() == blob              # disk copy complete
+        # temp-then-rename left no residue at any point
+        assert not [p for p in os.listdir(_spill_dir()) if ".tmp." in p]
+
+        assert store.restore("oidA", path) == len(blob)
+        assert bytes(store.read_raw("oidA")) == blob
+        assert not os.path.exists(path)          # spill file consumed
+    finally:
+        store.close()
+
+
+def test_spill_failed_rename_keeps_segment(monkeypatch):
+    """A crash between temp-write and rename (simulated: os.replace raises)
+    must leave the shm segment intact and no file — truncated or whole — at
+    the trusted spill path."""
+    from ray_tpu._private import object_store as os_mod
+
+    store = _fresh_store(monkeypatch)
+    try:
+        blob = os.urandom(4096)
+        store.put_raw("oidB", blob)
+        final = os.path.join(os_mod._spill_dir(), os_mod.seg_name("oidB"))
+
+        def boom(src, dst):
+            raise OSError("disk full mid-rename")
+
+        monkeypatch.setattr(os_mod.os, "replace", boom)
+        with pytest.raises(OSError):
+            store.spill("oidB")
+        monkeypatch.undo()
+        assert store.exists("oidB")              # segment untouched
+        assert bytes(store.read_raw("oidB")) == blob
+        assert not os.path.exists(final)         # no trusted-path file
+        assert not [p for p in os.listdir(os_mod._spill_dir())
+                    if ".tmp." in p]             # temp cleaned up
+    finally:
+        store.close()
+
+
+def test_restore_idempotent_when_segment_live(monkeypatch):
+    """Concurrent restore: the loser finds the segment already live — no
+    live-segment collision, its stale file is removed, bytes unchanged."""
+    store = _fresh_store(monkeypatch)
+    try:
+        blob = os.urandom(8192)
+        store.put_raw("oidC", blob)
+        path = store.spill("oidC")
+        assert store.restore("oidC", path) == len(blob)   # winner
+
+        stale = path  # the loser still holds the (now re-created) file path
+        with open(stale, "wb") as f:
+            f.write(blob)
+        assert store.restore("oidC", stale) == len(blob)  # loser: idempotent
+        assert bytes(store.read_raw("oidC")) == blob
+        assert not os.path.exists(stale)
+    finally:
+        store.close()
+
+
+def test_read_spilled_range(monkeypatch):
+    from ray_tpu._private.object_store import StoreClient
+
+    store = _fresh_store(monkeypatch)
+    try:
+        blob = os.urandom(1 << 15)
+        store.put_raw("oidD", blob)
+        path = store.spill("oidD")
+        assert StoreClient.read_spilled_range(path, 100, 500) == blob[100:600]
+        assert StoreClient.read_spilled_range(path, 0, 1) == blob[:1]
+        assert StoreClient.read_spilled(path) == blob
+        store.restore("oidD", path)
+    finally:
+        store.close()
+
+
+# ----------------------------------------------- pressure loop + protection
+
+_PRESSURE_SCRIPT = """
+import asyncio
+import numpy as np
+import ray_tpu as ray
+from ray_tpu import api
+from ray_tpu.util import metrics
+
+ray.init(num_cpus=2, object_store_memory=256 << 20)
+val = np.arange(1 << 18, dtype=np.uint8)          # 256 KiB: above inline max
+refs = [ray.put(val) for _ in range(6)]
+rt = api._runtime
+rt.client.flush()                                 # batched put deltas land
+
+async def drive():
+    c = rt.controller
+    for _ in range(200):                          # flusher applies on-loop
+        if all(c.objects.get(r.id) is not None
+               and c.objects[r.id].location == "shm" for r in refs):
+            break
+        await asyncio.sleep(0.02)
+    c.objects[refs[0].id].prefetched = True       # prefetch-pinned: spared
+    c._spill_down(0, pressure=True)               # drain all unprotected shm
+    c._tier_gauges()
+    return {r.id: c.objects[r.id].location for r in refs}
+
+locs = asyncio.run_coroutine_threadsafe(drive(), rt.loop).result(60)
+sc = metrics.spill_counters()
+assert locs[refs[0].id] == "shm", locs            # pinned object survived
+assert sum(1 for l in locs.values() if l == "spilled") >= 5, locs
+assert sc["pinned_demotions"] == 0, sc            # the ISSUE invariant
+assert sc["pinned_skips"] >= 1, sc
+assert sc["spilled_objects"] >= 5, sc
+assert sc["pressure_spills"] >= 5, sc
+assert sc["spill_bytes"] >= 5 * val.nbytes, sc
+occ = metrics.tier_occupancy()
+assert occ["disk_bytes"] >= 5 * val.nbytes, occ
+assert occ["disk_objects"] >= 5, occ
+# restores round-trip bit-identically through ray.get
+got = ray.get(list(refs), timeout=60)
+assert all((g == val).all() for g in got)
+sc2 = metrics.spill_counters()
+assert sc2["restored_objects"] >= 5, sc2
+assert sc2["restore_bytes"] >= 5 * val.nbytes, sc2
+print("PRESSURE_OK")
+"""
+
+
+def test_pressure_demotion_skips_pinned():
+    out = _run_script(_PRESSURE_SCRIPT)
+    assert "PRESSURE_OK" in out
+
+
+# -------------------------------------------- restore-before-dispatch (pull)
+
+_RESTORE_DISPATCH_SCRIPT = """
+import asyncio
+import numpy as np
+import ray_tpu as ray
+from ray_tpu import api
+from ray_tpu.util import metrics
+
+ray.init(num_cpus=2, object_store_memory=256 << 20)
+val = np.arange(1 << 18, dtype=np.float32)
+x = ray.put(val)
+rt = api._runtime
+rt.client.flush()                                 # batched put delta lands
+
+async def spill_all():
+    c = rt.controller
+    for _ in range(200):
+        m = c.objects.get(x.id)
+        if m is not None and m.location == "shm":
+            break
+        await asyncio.sleep(0.02)
+    c._spill_down(0, pressure=True)
+    return c.objects[x.id].location
+
+loc = asyncio.run_coroutine_threadsafe(spill_all(), rt.loop).result(60)
+assert loc == "spilled", loc
+
+@ray.remote
+def f(a):
+    return float(a[123])
+
+assert ray.get(f.remote(x), timeout=120) == 123.0
+sc = metrics.spill_counters()
+assert sc["restored_objects"] >= 1, sc
+assert sc["restore_bytes"] >= val.nbytes, sc
+
+async def where():
+    return rt.controller.objects[x.id].location
+
+assert asyncio.run_coroutine_threadsafe(where(), rt.loop).result(30) == "shm"
+print("RESTORE_DISPATCH_OK")
+"""
+
+
+def test_restore_before_dispatch_via_pull_manager():
+    out = _run_script(_RESTORE_DISPATCH_SCRIPT)
+    assert "RESTORE_DISPATCH_OK" in out
+
+
+# ------------------------------------------- working set larger than arena
+
+_OVERCOMMIT_SCRIPT = """
+import numpy as np
+import ray_tpu as ray
+from ray_tpu.util import metrics
+
+ray.init(num_cpus=1, object_store_memory=64 << 20)
+# 72 MB burst through a 64 MB arena: puts must ride the make-room RPC
+# (client retries after spill_for_put) instead of surfacing MemoryError
+blobs = [np.arange(i, i + (6 << 20) // 8, dtype=np.int64) for i in range(12)]
+refs = [ray.put(b) for b in blobs]
+# streaming re-reads churn the ladder both directions; each must be
+# bit-identical even when the read races a concurrent demotion
+for i, r in enumerate(refs):
+    got = ray.get(r, timeout=120)
+    assert np.array_equal(got, blobs[i]), i
+    del got
+sc = metrics.spill_counters()
+assert sc["spilled_objects"] >= 1, sc
+assert sc["restored_objects"] >= 1, sc
+assert sc["pinned_demotions"] == 0, sc
+print("OVERCOMMIT_OK")
+"""
+
+
+def test_put_burst_over_capacity_rides_make_room():
+    out = _run_script(_OVERCOMMIT_SCRIPT)
+    assert "OVERCOMMIT_OK" in out
+
+
+_REREAD_SCRIPT = """
+import asyncio
+import numpy as np
+import ray_tpu as ray
+from ray_tpu import api
+
+ray.init(num_cpus=1, object_store_memory=256 << 20)
+val = np.arange(1 << 16, dtype=np.float64)
+x = ray.put(val)
+rt = api._runtime
+rt.client.flush()
+
+async def demote():
+    c = rt.controller
+    for _ in range(200):
+        m = c.objects.get(x.id)
+        if m is not None and m.location == "shm":
+            break
+        await asyncio.sleep(0.02)
+    c._spill_down(0, pressure=True)
+    return c.objects[x.id].meta_len
+
+meta_len = asyncio.run_coroutine_threadsafe(demote(), rt.loop).result(60)
+# the client holds a STALE shm descriptor (as if demotion raced the read):
+# _materialize must re-request the descriptor, restoring the segment
+got = rt.client._materialize([x.id], [("shm", meta_len)])[0]
+assert np.array_equal(got, val)
+print("REREAD_OK")
+"""
+
+
+def test_stale_descriptor_reread_after_demotion():
+    out = _run_script(_REREAD_SCRIPT)
+    assert "REREAD_OK" in out
+
+
+# ------------------------------------------------- spilled-tier ranged pull
+
+class _FakeWriter:
+    def __init__(self):
+        self.buf = b""
+        self.closed = False
+
+    def write(self, b):
+        self.buf += b
+
+    async def drain(self):
+        pass
+
+    def close(self):
+        self.closed = True
+
+
+def test_serve_range_reads_spill_file_without_promotion(monkeypatch):
+    """ObjectDataServer serves a ranged pull of a spilled object straight
+    from the spill file — no _ensure_local, the object stays cold."""
+    from ray_tpu._private.node_agent import ObjectDataServer
+    from ray_tpu.util import metrics
+
+    store = _fresh_store(monkeypatch)
+    try:
+        blob = os.urandom(1 << 14)
+        store.put_raw("oidE", blob)
+        path = store.spill("oidE")
+
+        meta = types.SimpleNamespace(location="spilled", spill_path=path,
+                                     size=len(blob), meta_len=0, contained=[])
+
+        def no_promote(oid):
+            raise AssertionError("ranged pull promoted a spilled object")
+
+        c = types.SimpleNamespace(objects={"oidE": meta}, object_events={},
+                                  store=store, _ensure_local=no_promote)
+        srv = ObjectDataServer(c)
+        before = metrics._counter_total("spill_range_reads_total") or 0
+
+        w = _FakeWriter()
+        asyncio.run(srv._serve_range(w, "oidE", 64, 256))
+        head, _, rest = w.buf.partition(b"\n")
+        assert head == b"OK 256"
+        assert rest == blob[64:320]
+        assert meta.location == "spilled"        # still cold
+        after = metrics._counter_total("spill_range_reads_total") or 0
+        assert after == before + 1
+
+        # full-object serve also reads the file without promoting
+        w2 = _FakeWriter()
+        asyncio.run(srv._serve_one(w2, "oidE"))
+        head2, _, rest2 = w2.buf.partition(b"\n")
+        assert head2 == f"OK {len(blob)} 0".encode()
+        assert rest2.partition(b"\n")[2] == blob
+        assert os.path.exists(path)              # spill file untouched
+        store.restore("oidE", path)
+    finally:
+        store.close()

@@ -211,8 +211,68 @@ def _rung_serving(cl, kill):
     return n_req
 
 
+def _rung_spill(cl, kill):
+    """tiered-memory shape (ISSUE 19): the head's store is caught
+    mid-ladder — every driver-owned block force-demoted to the disk tier —
+    when the worker node dies. Driver-owned blocks must come back via
+    restore-from-disk, node-held blocks via lineage reconstruction; the
+    run must never hang, and the pressure loop must never have demoted a
+    prefetch-pinned object."""
+    import asyncio
+
+    import numpy as np
+
+    from ray_tpu import api
+    from ray_tpu.util import metrics
+    ray = cl.ray
+    n_blocks, n = 4, BLOCK_KB * 1024 // 8
+    strat = cl.soft_affinity()
+
+    @ray.remote(num_cpus=0.5)
+    def produce(i):
+        time.sleep(TASK_S)
+        return np.full(n, float(i))
+
+    node_refs = [produce.options(scheduling_strategy=strat).remote(i)
+                 for i in range(n_blocks)]
+    puts = [ray.put(np.full(n, 100.0 + i)) for i in range(n_blocks)]
+    ray.wait(node_refs, num_returns=n_blocks, timeout=120)
+
+    rt = api._runtime
+    rt.client.flush()
+
+    async def demote_all():
+        c = rt.controller
+        for _ in range(300):
+            if all(c.objects.get(r.id) is not None
+                   and c.objects[r.id].location == "shm" for r in puts):
+                break
+            await asyncio.sleep(0.02)
+        c._spill_down(0, pressure=True)
+        return [c.objects[r.id].location for r in puts]
+
+    locs = asyncio.run_coroutine_threadsafe(demote_all(), rt.loop).result(60)
+    assert all(loc == "spilled" for loc in locs), locs
+
+    sc0 = metrics.spill_counters()
+    if kill:
+        cl.kill_node()
+    # restore-from-disk: driver-owned blocks come back bit-identical
+    for i, got in enumerate(ray.get(puts, timeout=120)):
+        assert float(got[0]) == 100.0 + i and got.shape == (n,), (i, got[:3])
+    # lineage: node-held blocks reconstruct (or were already shipped)
+    for i, got in enumerate(ray.get(node_refs, timeout=120)):
+        assert float(got[0]) == float(i) and got.shape == (n,), (i, got[:3])
+    sc1 = metrics.spill_counters()
+    assert sc1["restored_objects"] - sc0["restored_objects"] >= n_blocks, (
+        sc0, sc1)
+    assert sc1["pinned_demotions"] == 0, sc1
+    return 2 * n_blocks
+
+
 _RUNGS = [("transfer", _rung_transfer), ("pipeline", _rung_pipeline),
-          ("sebulba", _rung_sebulba), ("serving", _rung_serving)]
+          ("sebulba", _rung_sebulba), ("serving", _rung_serving),
+          ("spill", _rung_spill)]
 
 
 def _recovery_windows(node_id=None, prefix=None):
@@ -348,6 +408,8 @@ def smoke():
     rec = {"bench": "chaos_ladder_smoke"}
     rec["transfer"] = _run_rung("transfer", _rung_transfer, kill=True)
     assert rec["transfer"]["reconstructions"] >= 1, rec
+    # kill-mid-spill (ISSUE 19): restore-from-disk + lineage, never hangs
+    rec["spill"] = _run_rung("spill", _rung_spill, kill=True)
     rec["reconcile"] = _rung_reconcile()
     print(json.dumps(rec))
 
