@@ -641,6 +641,7 @@ def orchestrate():
                 ("transfer_dp", "transfer_bench.py", 300, None),
                 ("chain_dp", "chain_bench.py", 300, None),
                 ("pipeline_pp", "pipeline_bench.py", 600, None),
+                ("serve_fleet", "fleet_bench.py", 900, None),
                 ("chaos_ladder", os.path.join("..", "tools",
                                               "chaos_ladder.py"), 600, None)):
             result[key] = _run_aux_bench(script, tmo, extra)

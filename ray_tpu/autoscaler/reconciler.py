@@ -51,6 +51,41 @@ def scale_down_idle_s() -> float:
         return 60.0
 
 
+class ScaleLedger:
+    """Bounded causality audit trail for scaling actions — the PR 17
+    reconciler's `_record` shape, factored out so the serve controller's
+    SLO-driven deployment scaling (ISSUE 20) runs through the same path:
+    every action appends a timestamped record and bumps a tagged counter,
+    giving `fleet_bench` and the tests an exact reaction-time measurement
+    (burst start -> first scale_up record). Clock-injectable like the
+    reconciler itself."""
+
+    def __init__(self, clock: Callable[[], float] = time.time,
+                 cap: int = 256, counter: str = "reconciler_actions_total"):
+        self.clock = clock
+        self.cap = cap
+        self.counter = counter
+        self.events: List[dict] = []
+
+    def record(self, action: str, **fields) -> dict:
+        ev = {"ts": self.clock(), "action": action}
+        ev.update(fields)
+        self.events.append(ev)
+        del self.events[:-self.cap]
+        try:
+            from ..util import metrics
+            metrics.get_or_create(
+                metrics.Counter, self.counter,
+                "scaling actions by type", tag_keys=("action",)
+            ).inc(tags={"action": action})
+        except Exception:  # noqa: BLE001 - actions must not need metrics
+            pass
+        return ev
+
+    def tail(self, n: int = 32) -> List[dict]:
+        return [dict(ev) for ev in self.events[-n:]]
+
+
 class Reconciler:
     # alert kinds that demand capacity (vs node_dead's replacement path)
     _PRESSURE_KINDS = ("store_pressure", "queue_growth")
@@ -75,7 +110,8 @@ class Reconciler:
         # handle -> {"t_create": ..., "alert_id": ..., "kind": ...} for
         # launches awaiting registration (time-to-recovered measurement)
         self._pending: Dict[str, dict] = {}
-        self.events: List[dict] = []  # causality audit trail (bounded)
+        self._ledger = ScaleLedger(clock=clock)
+        self.events = self._ledger.events  # causality audit trail (bounded)
         self.replacements = 0
         self.scale_ups = 0
         self.scale_downs = 0
@@ -96,22 +132,12 @@ class Reconciler:
 
     def _record(self, action: str, handle: Optional[str],
                 alert: Optional[dict], **extra):
-        ev = {"ts": self.clock(), "action": action, "handle": handle,
-              "alert_id": alert["id"] if alert else None,
-              "alert_kind": alert["kind"] if alert else None,
-              "alert_key": alert["key"] if alert else None}
-        ev.update(extra)
-        self.events.append(ev)
-        del self.events[:-256]
-        try:
-            from ..util import metrics
-            metrics.get_or_create(
-                metrics.Counter, "reconciler_actions_total",
-                "reconciler provider actions by type", tag_keys=("action",)
-            ).inc(tags={"action": action})
-        except Exception:  # noqa: BLE001 - actions must not need metrics
-            pass
-        return ev
+        fields = {"handle": handle,
+                  "alert_id": alert["id"] if alert else None,
+                  "alert_kind": alert["kind"] if alert else None,
+                  "alert_key": alert["key"] if alert else None}
+        fields.update(extra)  # callers may override (e.g. recovered's
+        return self._ledger.record(action, **fields)  # explicit alert_id)
 
     def _window(self, name: str, t0: float, t1: float, **args):
         try:

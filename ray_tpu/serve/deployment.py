@@ -17,6 +17,16 @@ class AutoscalingConfig:
     target_ongoing_requests: float = 2.0
     upscale_delay_s: float = 0.0
     downscale_delay_s: float = 0.0
+    # SLO-driven scaling (ISSUE 20): when set, the controller compares each
+    # interval's windowed replica SLO snapshot (serve_ttft_s/serve_tpot_ms
+    # p99, batch occupancy) against these targets — a breach forces a
+    # one-step scale-up even if ongoing-count math is satisfied, and a
+    # scale-down is held unless the fleet sits comfortably inside target
+    # (p99 <= downscale_slo_margin * target).
+    target_ttft_p99_s: Optional[float] = None
+    target_tpot_p99_ms: Optional[float] = None
+    occupancy_high: float = 0.85
+    downscale_slo_margin: float = 0.5
 
 
 @dataclasses.dataclass

@@ -319,6 +319,9 @@ class LLMServer:
             "KV pages in use / page pool size, sampled per decode sync",
             boundaries=[0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0],
             tag_keys=("engine",))
+        # windowed SLO reads for the fleet autoscaler: each slo_snapshot()
+        # call summarizes only the observations since the previous call
+        self._slo_window_state = {}
         self._free = list(range(B))
         self._req_counter = 0
         self._tick_task = None
@@ -1148,6 +1151,37 @@ class LLMServer:
         tokens[0, :P] = prompt_ids
         vec = self._embed_jit(self.params, jnp.asarray(tokens), jnp.int32(P))
         return [float(x) for x in np.asarray(vec)]
+
+    def prefix_digest(self, max_bytes: int = None) -> Optional[Dict]:
+        """Hot-prefix digest for the affinity router (ISSUE 20): the radix
+        tree's resident-or-restorable chains, hashed + hit-counted, packed
+        <= 4 KiB. None for dense/flat-cache engines (nothing to advertise).
+        The serve Replica wrapper piggybacks this on its stats() frame."""
+        from ray_tpu.serve.radix_cache import RadixPageManager
+        if isinstance(self.page_mgr, RadixPageManager):
+            return self.page_mgr.prefix_digest(max_bytes)
+        return None
+
+    def slo_snapshot(self) -> Dict[str, Any]:
+        """Windowed SLO read for the fleet autoscaler: TTFT/TPOT quantiles
+        and batch occupancy over the observations since the LAST call (the
+        controller polls once per evaluation interval, so this is the
+        per-interval signal — a cumulative p99 would mask fresh breaches)."""
+        from ray_tpu.util import metrics as _metrics
+        ttft = _metrics.histogram_window("serve_ttft_s",
+                                         self._slo_window_state)
+        tpot = _metrics.histogram_window("serve_tpot_ms",
+                                         self._slo_window_state)
+        occ = _metrics.histogram_window("serve_batch_occupancy",
+                                        self._slo_window_state)
+        return {
+            "ttft_p99_s": ttft["p99"] if ttft else None,
+            "ttft_count": ttft["count"] if ttft else 0,
+            "tpot_p99_ms": tpot["p99"] if tpot else None,
+            "occupancy_mean": occ["mean"] if occ else None,
+            "active": len(self._active),
+            "free_slots": len(self._free),
+        }
 
     def stats(self) -> Dict[str, Any]:
         s = {"active": len(self._active), "free_slots": len(self._free),

@@ -127,6 +127,27 @@ class _ModelCache:
             self._loading.pop(model_id, None)
 
 
+def should_rebalance_pin(inflight_by_idx, pinned_idx: int,
+                         factor: float = 2.0, min_inflight: int = 2) -> bool:
+    """Evict a model->replica pin when the pinned replica's handle-local
+    inflight exceeds `factor`x the fleet median (ISSUE 20 satellite: sticky
+    affinity previously never rebalanced, so one hot LoRA pinned its
+    replica into the ground while the rest of the fleet idled).
+
+    median_low, not the interpolated median: with two replicas the
+    interpolated median of [hot, idle] is (hot+idle)/2, and hot > 2*that
+    is algebraically impossible — the smallest fleet could never rebalance.
+    `min_inflight` keeps single-digit blips from flapping pins."""
+    import statistics
+    n = len(inflight_by_idx)
+    if n < 2 or pinned_idx >= n:
+        return False
+    q = inflight_by_idx[pinned_idx]
+    if q < min_inflight:
+        return False
+    return q > factor * statistics.median_low(inflight_by_idx)
+
+
 def multiplexed(func: Optional[Callable] = None, *,
                 max_num_models_per_replica: int = 3):
     """Decorator for the model-loading method of a deployment:

@@ -387,6 +387,32 @@ class RadixPageManager(PageManager):
     def cached_pages(self) -> int:
         return len(self._node_of)
 
+    def prefix_digest(self, max_bytes: int = None) -> dict:
+        """Compact digest of this tree's hot prefixes for the affinity
+        router (ISSUE 20): {chained page hash -> hits} over every node a
+        request could actually borrow — resident pages AND demoted-but-
+        restorable ones, so the digest is stable under LRU demotion to the
+        stash (only a true discard drops an entry). Bounded to `max_bytes`
+        packed (default RAY_TPU_PREFIX_DIGEST_BYTES=4096) by hottest-first
+        truncation; children of a non-usable node are skipped because
+        `_walk` stops at the hole anyway."""
+        from ray_tpu.serve import prefix_digest as _pd
+        if max_bytes is None:
+            max_bytes = _pd.digest_max_bytes()
+        restorable = self.restore_cb is not None
+        cand = []
+        stack = [(self._root, 0, 0)]
+        while stack:
+            node, chain, depth = stack.pop()
+            for child in node.children.values():
+                if child.page is None and (child.handle is None
+                                           or not restorable):
+                    continue  # hole: nothing below it is borrowable
+                ch = _pd.chain_hash(chain, child.tokens)
+                cand.append((ch, child.hits, depth + 1))
+                stack.append((child, ch, depth + 1))
+        return _pd.build(cand, self.page_size, max_bytes)
+
     def node_stats(self) -> dict:
         """Flat tree accounting for stats()/benchmarks."""
         return {"prefix_nodes": self.prefix_nodes,

@@ -96,7 +96,30 @@ class Replica:
             self._ongoing -= 1
 
     def stats(self):
-        return {"ongoing": self._ongoing, "total": self._total}
+        """Replica-state frame the controller polls each autoscale interval
+        and the handle refresh rides on (ISSUE 20): ongoing/total plus —
+        when the hosted deployment exposes them — the hot-prefix digest for
+        affinity routing and the windowed SLO snapshot for scale decisions.
+        Both piggyback on this existing frame; no new request-path round
+        trips. `pid` lets chaos tooling hard-kill one replica's process."""
+        import os
+        s = {"ongoing": self._ongoing, "total": self._total,
+             "pid": os.getpid()}
+        digest_fn = getattr(self.instance, "prefix_digest", None)
+        if callable(digest_fn):
+            try:
+                d = digest_fn()
+                if d:
+                    s["prefix_digest"] = d
+            except Exception:  # noqa: BLE001 - routing hints are best-effort
+                pass
+        slo_fn = getattr(self.instance, "slo_snapshot", None)
+        if callable(slo_fn):
+            try:
+                s["slo"] = slo_fn()
+            except Exception:  # noqa: BLE001
+                pass
+        return s
 
     def health_check(self):
         fn = getattr(self.instance, "check_health", None)

@@ -204,6 +204,45 @@ def histogram_summary(name: str,
     return out
 
 
+def histogram_window(name: str, state: Dict,
+                     qs: Sequence[float] = (0.5, 0.9, 0.99)
+                     ) -> Optional[Dict[str, float]]:
+    """Quantile summary of the observations made SINCE the previous call
+    with the same `state` dict (mutated in place; pass {} on first use).
+
+    Histograms are cumulative, so an all-time p99 answers "how was the
+    whole day" — the SLO autoscaler needs "how is the last evaluation
+    interval", else a quiet hour masks a fresh breach (and a past burst
+    blocks scale-down forever). None when no new observations landed."""
+    with _registry_lock:
+        m = _registry.get(name)
+    if not isinstance(m, Histogram):
+        return None
+    snap = m.snapshot()
+    bounds = snap["boundaries"]
+    merged = [0] * (len(bounds) + 1)
+    for series in snap["buckets"].values():
+        for i, n in enumerate(series):
+            merged[i] += n
+    s = sum(snap["sum"].values())
+    prev = state.get(name)
+    state[name] = {"merged": merged, "sum": s}
+    if prev is None or len(prev["merged"]) != len(merged):
+        delta, dsum = merged, s
+    else:
+        delta = [a - b for a, b in zip(merged, prev["merged"])]
+        dsum = s - prev["sum"]
+        if any(d < 0 for d in delta):  # registry reset between calls
+            delta, dsum = merged, s
+    total = sum(delta)
+    if total <= 0:
+        return None
+    out = {"count": total, "sum": dsum, "mean": dsum / total}
+    for q in qs:
+        out[f"p{int(q * 100)}"] = _bucket_quantile(q, bounds, delta, total)
+    return out
+
+
 # -- control-plane transport counters ---------------------------------------
 # The raw tallies live in _private/protocol.py (imported during
 # ray_tpu/__init__, so it cannot depend on this package); these helpers are
@@ -437,3 +476,19 @@ def radix_counters() -> Dict[str, float]:
             "evicted_pages": _counter_total("radix_evicted_pages"),
             "demoted_pages": _counter_total("radix_demoted_pages"),
             "restored_pages": _counter_total("radix_restored_pages")}
+
+
+def serve_fleet_counters() -> Dict[str, float]:
+    """Fleet-routing tallies for the CURRENT process (ISSUE 20). Handle
+    side: affinity_hits routed to a prefix-matching replica, affinity_spills
+    bounced to p2c because the match's queue was too deep, affinity_misses
+    had no matching digest; mux_rebalances evicted a multiplex model pin off
+    an overloaded replica; died_retries re-routed a request whose replica
+    died mid-flight. Controller side: scale_events counts SLO-autoscale
+    ledger records."""
+    return {"affinity_hits": _counter_total("serve_affinity_hits_total"),
+            "affinity_misses": _counter_total("serve_affinity_misses_total"),
+            "affinity_spills": _counter_total("serve_affinity_spills_total"),
+            "mux_rebalances": _counter_total("serve_mux_rebalances_total"),
+            "died_retries": _counter_total("serve_died_retries_total"),
+            "scale_events": _counter_total("serve_scale_events_total")}

@@ -375,6 +375,73 @@ def _rung_reconcile():
         ray_tpu.shutdown()
 
 
+def _rung_fleet():
+    """Serve-fleet rung (ISSUE 20): SIGKILL one of three replicas under
+    load. Gates — zero failed requests (the handle retries on a survivor),
+    bounded p99 during the chaos burst, and after one handle refresh
+    interval the controller has pruned the corpse so no request pays a
+    died-retry again."""
+    import ray_tpu
+    from ray_tpu import serve
+    from ray_tpu.serve.controller import get_controller
+    from ray_tpu.util import metrics
+
+    ray_tpu.init(num_cpus=6, cluster_port=0)
+    try:
+        @serve.deployment(num_replicas=3, max_ongoing_requests=8)
+        class Echo:
+            def ping(self, i):
+                time.sleep(0.01)
+                return i
+
+        h = serve.run(Echo.bind(), name="chaos-fleet")
+        hp = h.options(method_name="ping")
+
+        def burst(n):
+            t0 = time.time()
+            resps = [hp.remote(i) for i in range(n)]
+            failed, lats = 0, []
+            for i, r in enumerate(resps):
+                t1 = time.time()
+                try:
+                    assert r.result(timeout_s=60) == i
+                except Exception:  # noqa: BLE001 - counted by the gate
+                    failed += 1
+                lats.append(time.time() - t1)
+            lats.sort()
+            return {"n": n, "failed": failed, "wall_s": time.time() - t0,
+                    "p99_s": round(lats[int(len(lats) * 0.99)
+                                        if len(lats) > 1 else -1], 4)}
+
+        base = burst(40)
+        ctrl = get_controller()
+        reps = ray_tpu.get(ctrl.get_replicas.remote("chaos-fleet", "Echo"))
+        victim_pid = ray_tpu.get(reps[0].stats.remote())["pid"]
+        os.kill(victim_pid, signal.SIGKILL)
+        chaos = burst(40)
+        d_mid = metrics.serve_fleet_counters()["died_retries"]
+        # > handle refresh TTL (0.5s) + death-report round trip: every
+        # handle's next pick must come from the pruned survivor list
+        time.sleep(0.8)
+        steady = burst(30)
+        d_end = metrics.serve_fleet_counters()["died_retries"]
+        survivors = len(ray_tpu.get(
+            ctrl.get_replicas.remote("chaos-fleet", "Echo")))
+        rec = {"baseline": base, "chaos": chaos, "steady": steady,
+               "died_retries": round(d_mid),
+               "died_retries_after_refresh": round(d_end - d_mid),
+               "survivors": survivors}
+        assert chaos["failed"] == 0 and steady["failed"] == 0, rec
+        assert d_mid >= 1, rec                       # the kill was felt
+        assert rec["died_retries_after_refresh"] == 0, rec  # corpse pruned
+        assert survivors == 2, rec
+        assert chaos["p99_s"] <= max(5 * base["p99_s"], 2.0), rec
+        serve.shutdown()
+        return rec
+    finally:
+        ray_tpu.shutdown()
+
+
 # ------------------------------------------------------------------- modes
 
 def run_ladder(rungs=None):
@@ -387,6 +454,7 @@ def run_ladder(rungs=None):
                      "slowdown": slowdown,
                      "ok": slowdown <= SLOWDOWN_BUDGET}
     out["reconcile"] = _rung_reconcile()
+    out["fleet"] = _rung_fleet()
     return out
 
 
@@ -411,6 +479,9 @@ def smoke():
     # kill-mid-spill (ISSUE 19): restore-from-disk + lineage, never hangs
     rec["spill"] = _run_rung("spill", _rung_spill, kill=True)
     rec["reconcile"] = _rung_reconcile()
+    # serve-fleet kill (ISSUE 20): re-route on survivor, corpse pruned
+    # within one refresh interval, zero failed requests
+    rec["fleet"] = _rung_fleet()
     print(json.dumps(rec))
 
 
